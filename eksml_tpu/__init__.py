@@ -1,0 +1,29 @@
+"""eksml_tpu — TPU-native distributed Mask-RCNN training framework.
+
+A ground-up re-design of the capability set of
+`MarcandreBoulon/amazon-eks-machine-learning-with-terraform-and-kubeflow`
+(an EKS + Kubeflow MPIJob + Horovod/NCCL + TensorPack Mask-RCNN scaffold)
+for TPU hardware:
+
+- compute path: JAX / Flax / Pallas, static shapes, bf16 on the MXU
+- parallelism: SPMD data-parallel over a `jax.sharding.Mesh` (ICI/DCN
+  collectives inserted by XLA), replacing Horovod ring-allreduce over NCCL
+  (reference: charts/maskrcnn/values.yaml:24-28)
+- launch: JobSet + `jax.distributed.initialize`, replacing
+  mpi-operator/MPIJob (reference: charts/mpijob/templates/mpijob.yaml)
+- checkpoint: Orbax on a shared filesystem, replacing TF `model-<step>`
+  checkpoints on EFS (reference: charts/maskrcnn/templates/maskrcnn.yaml:58-59)
+
+Package layout (SURVEY.md §7):
+  config.py   config tree + dotted KEY=VALUE overrides
+  data/       COCO loader, static-shape padding/batching
+  ops/        boxes, anchors, NMS, ROIAlign (XLA + Pallas)
+  models/     Flax ResNet-FPN Mask-RCNN
+  parallel/   mesh builder, distributed init, collectives
+  train.py    training loop, Orbax, metrics, periodic eval
+  evalcoco/   COCO mAP evaluation (no pycocotools dependency)
+  predict/    offline predictor + visualization
+  utils/      checkpointing, metrics, logging helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,304 @@
+"""Configuration tree with dotted KEY=VALUE overrides.
+
+Re-creates the config UX of the reference stack: TensorPack's
+``train.py --config KEY=VALUE`` dotted-path override system, which the
+Helm charts render into argv (reference:
+charts/maskrcnn/templates/maskrcnn.yaml:60-72, run.sh:33-45) and the viz
+notebooks mutate in-process (container-viz/notebooks/
+mask-rcnn-tensorpack-viz.ipynb cell 9).  The default key names below are
+kept compatible with the ones the reference charts set (MODE_MASK,
+MODE_FPN, DATA.*, BACKBONE.*, TRAIN.*, TRAINER) so a values.yaml written
+for the reference maps 1:1, while TPU-specific knobs live under ``TPU.*``
+(mesh shape, XLA collective-combine thresholds — the analogue of the
+HOROVOD_FUSION_THRESHOLD / NCCL_MIN_NRINGS env tuning at
+charts/maskrcnn/values.yaml:24-28).
+
+Design is TPU-first: everything that shapes a compiled program (image
+size, proposal counts, batch size) is a *static* config value, because
+XLA traces once — there is no dynamic-shape escape hatch like the
+reference's variable-size dataflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+import json
+import os
+import pprint
+from typing import Any, Iterable, List
+
+
+class AttrDict:
+    """Nested attribute dictionary with freeze semantics.
+
+    Access creates nested nodes on the fly until :meth:`freeze` is
+    called; afterwards unknown keys raise.  This mirrors the behavior of
+    the reference's config object so ``--config`` typos fail loudly.
+    """
+
+    _frozen = False
+
+    def __getattr__(self, name: str) -> Any:
+        if self._frozen:
+            raise AttributeError(f"unknown config key: {name}")
+        if name.startswith("_"):
+            raise AttributeError(name)
+        node = AttrDict()
+        object.__setattr__(self, name, node)
+        return node
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if self._frozen and name not in self.__dict__ and not name.startswith("_"):
+            raise AttributeError(f"cannot add config key after freeze: {name}")
+        object.__setattr__(self, name, value)
+
+    # -- tree utilities ------------------------------------------------
+
+    def freeze(self, frozen: bool = True) -> None:
+        object.__setattr__(self, "_frozen", frozen)
+        for v in self.__dict__.values():
+            if isinstance(v, AttrDict):
+                v.freeze(frozen)
+
+    def to_dict(self) -> dict:
+        return {
+            k: v.to_dict() if isinstance(v, AttrDict) else v
+            for k, v in self.__dict__.items()
+            if not k.startswith("_")
+        }
+
+    def from_dict(self, d: dict) -> None:
+        for k, v in d.items():
+            if isinstance(v, dict):
+                getattr(self, k).from_dict(v)
+            else:
+                setattr(self, k, v)
+
+    def clone(self) -> "AttrDict":
+        return copy.deepcopy(self)
+
+    def __repr__(self) -> str:
+        return pprint.pformat(self.to_dict())
+
+    # -- dotted-path overrides ----------------------------------------
+
+    def get_path(self, path: str) -> Any:
+        node: Any = self
+        for part in path.split("."):
+            node = getattr(node, part)
+        return node
+
+    def set_path(self, path: str, value: Any) -> None:
+        parts = path.split(".")
+        node: Any = self
+        for part in parts[:-1]:
+            node = getattr(node, part)
+        setattr(node, parts[-1], value)
+
+    def update_args(self, args: Iterable[str]) -> None:
+        """Apply ``KEY=VALUE`` strings (the ``--config`` override UX).
+
+        Values are parsed as Python literals when possible (so
+        ``TRAIN.LR_SCHEDULE=[240000,320000,360000]`` and
+        ``MODE_MASK=True`` work, matching the argv rendered at
+        reference charts/maskrcnn/templates/maskrcnn.yaml:60-72);
+        otherwise kept as strings (paths like ``DATA.BASEDIR=/efs/data``).
+        """
+        for arg in args:
+            if "=" not in arg:
+                raise ValueError(f"config override must be KEY=VALUE, got: {arg}")
+            key, value = arg.split("=", 1)
+            key = key.strip()
+            try:
+                existing = self.get_path(key)
+                if isinstance(existing, AttrDict):
+                    raise KeyError(key)
+            except (AttributeError, KeyError) as e:
+                raise KeyError(f"unknown config key: {key}") from e
+            self.set_path(key, _parse_value(value, existing))
+
+
+def _parse_value(text: str, existing: Any) -> Any:
+    text = text.strip()
+    try:
+        value = ast.literal_eval(text)
+    except (ValueError, SyntaxError):
+        value = text  # bare string (paths, names)
+    # Keep tuple-vs-list flexibility but respect existing bool/str types.
+    if isinstance(existing, bool) and isinstance(value, str):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(existing, str) and not isinstance(value, str):
+        return str(value)
+    return value
+
+
+config = AttrDict()
+_C = config  # shorthand used below, TensorPack-style
+
+
+def _define_defaults() -> None:
+    # ---- mode flags (reference templates/maskrcnn.yaml:61-62) -------
+    _C.MODE_MASK = True
+    _C.MODE_FPN = True
+    _C.MODE_CASCADE = False        # Cascade R-CNN stretch config
+
+    # ---- trainer selection ------------------------------------------
+    # Reference sets TRAINER=horovod (templates/maskrcnn.yaml:71); here
+    # the only value is the SPMD mesh trainer.
+    _C.TRAINER = "spmd"
+
+    # ---- data (reference values.yaml:12-22, stage-data contract) ----
+    _C.DATA.BASEDIR = "/efs/data"
+    _C.DATA.TRAIN = ("train2017",)
+    _C.DATA.VAL = "val2017"
+    _C.DATA.NUM_CLASSES = 81       # 80 COCO categories + background
+    _C.DATA.MAX_GT_BOXES = 100     # static padding for ragged GT
+    _C.DATA.SYNTHETIC = False      # tests/bench: generated data, no disk
+
+    # ---- preprocessing (static shapes are load-bearing on TPU) ------
+    _C.PREPROC.TRAIN_SHORT_EDGE_SIZE = (800, 800)
+    _C.PREPROC.TEST_SHORT_EDGE_SIZE = 800
+    _C.PREPROC.MAX_SIZE = 1344     # multiple of 128: pad target H=W
+    _C.PREPROC.PIXEL_MEAN = (123.675, 116.28, 103.53)
+    _C.PREPROC.PIXEL_STD = (58.395, 57.12, 57.375)
+
+    # ---- backbone (reference values.yaml:21-22, run.sh:16,43-44) ----
+    _C.BACKBONE.WEIGHTS = ""       # path to ImageNet-R50-AlignPadding.npz
+    _C.BACKBONE.RESNET_NUM_BLOCKS = (3, 4, 6, 3)  # R50; (3,4,23,3) = R101
+    _C.BACKBONE.NORM = "FreezeBN"  # FreezeBN | GN
+    _C.BACKBONE.FREEZE_AT = 2      # freeze conv1 + res2, TensorPack default
+
+    # ---- FPN --------------------------------------------------------
+    _C.FPN.NUM_CHANNEL = 256
+    _C.FPN.ANCHOR_STRIDES = (4, 8, 16, 32, 64)
+    _C.FPN.PROPOSAL_MODE = "level"
+    _C.FPN.FRCNN_FC_HEAD_DIM = 1024
+
+    # ---- anchors / RPN ----------------------------------------------
+    _C.RPN.ANCHOR_SIZES = (32, 64, 128, 256, 512)
+    _C.RPN.ANCHOR_RATIOS = (0.5, 1.0, 2.0)
+    _C.RPN.POSITIVE_ANCHOR_THRESH = 0.7
+    _C.RPN.NEGATIVE_ANCHOR_THRESH = 0.3
+    _C.RPN.BATCH_PER_IM = 256      # sampled anchors for the RPN loss
+    _C.RPN.FG_RATIO = 0.5
+    _C.RPN.MIN_SIZE = 0.0
+    _C.RPN.PROPOSAL_NMS_THRESH = 0.7
+    # static per-level topk before NMS and fixed post-NMS counts:
+    _C.RPN.TRAIN_PRE_NMS_TOPK = 2000
+    _C.RPN.TRAIN_POST_NMS_TOPK = 1000
+    _C.RPN.TEST_PRE_NMS_TOPK = 1000
+    _C.RPN.TEST_POST_NMS_TOPK = 1000
+
+    # ---- RCNN heads -------------------------------------------------
+    _C.FRCNN.BATCH_PER_IM = 512    # sampled proposals for the head loss
+    _C.FRCNN.FG_THRESH = 0.5
+    _C.FRCNN.FG_RATIO = 0.25
+    _C.FRCNN.BBOX_REG_WEIGHTS = (10.0, 10.0, 5.0, 5.0)
+    _C.MRCNN.HEAD_DIM = 256
+    _C.MRCNN.RESOLUTION = 28
+
+    # ---- cascade (stretch; BASELINE.json configs[4]) ----------------
+    _C.CASCADE.IOUS = (0.5, 0.6, 0.7)
+    _C.CASCADE.BBOX_REG_WEIGHTS = ((10., 10., 5., 5.), (20., 20., 10., 10.),
+                                   (30., 30., 15., 15.))
+
+    # ---- test-time --------------------------------------------------
+    _C.TEST.FRCNN_NMS_THRESH = 0.5
+    _C.TEST.RESULT_SCORE_THRESH = 0.05
+    _C.TEST.RESULTS_PER_IM = 100
+
+    # ---- training schedule (reference values.yaml:14-16,29) ---------
+    _C.TRAIN.NUM_CHIPS = 1         # ≙ gpus in values.yaml:8
+    _C.TRAIN.CHIPS_PER_HOST = 4    # ≙ gpus_per_node (v5e host = 4 chips)
+    _C.TRAIN.BATCH_SIZE_PER_CHIP = 1   # ≙ TRAIN.BATCH_SIZE_PER_GPU
+    _C.TRAIN.BASE_LR = 0.01        # per 8-image global batch, linearly scaled
+    _C.TRAIN.WARMUP_STEPS = 500
+    _C.TRAIN.WARMUP_INIT_FACTOR = 0.33
+    _C.TRAIN.WEIGHT_DECAY = 1e-4
+    _C.TRAIN.MOMENTUM = 0.9
+    _C.TRAIN.GRADIENT_CLIP = 0.0   # optimized chart uses 0.36 (values.yaml:32)
+    _C.TRAIN.STEPS_PER_EPOCH = 120000  # "must equal 120000/chips" values.yaml:14
+    _C.TRAIN.LR_SCHEDULE = (240000, 320000, 360000)
+    _C.TRAIN.LR_EPOCH_SCHEDULE = ()    # optimized: ((16,0.1),(20,0.01),(24,None))
+    _C.TRAIN.MAX_EPOCHS = 24
+    _C.TRAIN.EVAL_PERIOD = 1       # epochs (values.yaml:16)
+    _C.TRAIN.CHECKPOINT_PERIOD = 2 # epochs (values.yaml:29 extra_config)
+    _C.TRAIN.LOG_PERIOD = 20       # steps between metric writes
+    _C.TRAIN.SEED = 0
+    _C.TRAIN.PRECISION = "float32" # "bfloat16" ≙ TENSORPACK_FP16/--fp16
+    _C.TRAIN.LOGDIR = "/tmp/eksml_tpu/train_log/maskrcnn"
+
+    # ---- TPU / comm layer (≙ HOROVOD_*/NCCL_* env, values.yaml:24-28)
+    _C.TPU.MESH_SHAPE = ()         # () → (num_devices, 1)
+    _C.TPU.MESH_AXES = ("data", "model")
+    _C.TPU.TOPOLOGY = ""           # e.g. "v5e-32"; validated like the CRD schema
+    _C.TPU.ALLREDUCE_COMBINE_THRESHOLD_BYTES = 64 * 1024 * 1024
+    _C.TPU.COORDINATOR_ADDRESS = ""   # JobSet headless-service DNS
+    _C.TPU.NUM_PROCESSES = 1
+    _C.TPU.PROCESS_ID = 0
+
+    _C.freeze()
+
+
+_define_defaults()
+
+
+def finalize_configs(is_training: bool) -> AttrDict:
+    """Validate + derive dependent values; returns the frozen config.
+
+    Mirrors TensorPack's ``finalize_configs`` call the notebooks re-run
+    before inference (viz notebook cell 9).
+    """
+    _C.freeze(False)
+
+    assert _C.BACKBONE.NORM in ("FreezeBN", "GN"), _C.BACKBONE.NORM
+    assert _C.TRAIN.PRECISION in ("float32", "bfloat16"), _C.TRAIN.PRECISION
+    assert len(_C.FPN.ANCHOR_STRIDES) == len(_C.RPN.ANCHOR_SIZES)
+    assert _C.PREPROC.MAX_SIZE % max(_C.FPN.ANCHOR_STRIDES) == 0, (
+        "padded image size must be divisible by the coarsest FPN stride")
+    if isinstance(_C.DATA.TRAIN, str):
+        _C.DATA.TRAIN = (_C.DATA.TRAIN,)
+
+    if is_training:
+        # Reference couples steps/epoch to world size: 120000/N
+        # (values.yaml:14, run.sh:15).  Recompute rather than trust the
+        # caller, but only when the caller left the single-chip default.
+        if _C.TRAIN.STEPS_PER_EPOCH == 120000 and _C.TRAIN.NUM_CHIPS > 1:
+            _C.TRAIN.STEPS_PER_EPOCH = 120000 // _C.TRAIN.NUM_CHIPS
+        if _C.TRAIN.LR_EPOCH_SCHEDULE:
+            # optimized-chart form [(16,0.1),(20,0.01),(24,None)]
+            # (charts/maskrcnn-optimized/values.yaml:18) → step boundaries.
+            sched = []
+            for epoch, mult in _C.TRAIN.LR_EPOCH_SCHEDULE:
+                if mult is None:
+                    _C.TRAIN.MAX_EPOCHS = epoch
+                else:
+                    sched.append(epoch * _C.TRAIN.STEPS_PER_EPOCH)
+            _C.TRAIN.LR_SCHEDULE = tuple(sched)
+
+    _C.freeze()
+    return _C
+
+
+def config_from_env(cfg: AttrDict = None) -> AttrDict:
+    """Fill comm-layer settings from JobSet downward-API env vars.
+
+    Replaces the mpirun rank/hostfile plumbing (reference run.sh:20-27,
+    §3.2 kubectl-delivery) with env the JobSet chart injects.
+    """
+    cfg = cfg or _C
+    cfg.freeze(False)
+    cfg.TPU.COORDINATOR_ADDRESS = os.environ.get(
+        "COORDINATOR_ADDRESS", cfg.TPU.COORDINATOR_ADDRESS)
+    cfg.TPU.NUM_PROCESSES = int(os.environ.get(
+        "NUM_PROCESSES", cfg.TPU.NUM_PROCESSES))
+    cfg.TPU.PROCESS_ID = int(os.environ.get(
+        "PROCESS_ID", os.environ.get("JOB_COMPLETION_INDEX",
+                                     cfg.TPU.PROCESS_ID)))
+    cfg.freeze()
+    return cfg
+
+
+def dump_config(cfg: AttrDict = None) -> str:
+    return json.dumps((cfg or _C).to_dict(), indent=2, default=str)

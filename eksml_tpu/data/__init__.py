@@ -1,0 +1,20 @@
+"""Data layer: COCO loading, static-shape batching, per-host sharding.
+
+Replaces TensorPack's DataFlow-based async input pipeline (external,
+container/Dockerfile:16-19) with a TPU-first design: every batch has
+compile-time-constant shapes (padded images, fixed MAX_GT_BOXES with
+validity masks, bbox-cropped fixed-resolution GT masks), and every host
+in a multi-host job iterates the *same number of steps* per epoch —
+uneven per-host shards would deadlock XLA collectives
+(SURVEY.md §7 hard part #4).
+
+The on-disk contract matches the reference's staged layout
+(`/efs/data/{train2017,val2017,annotations}` —
+eks-cluster/stage-data.yaml:30-36, charts/maskrcnn/values.yaml:13).
+"""
+
+from eksml_tpu.data.coco import CocoDataset  # noqa: F401
+from eksml_tpu.data.loader import (  # noqa: F401
+    DetectionLoader, SyntheticDataset, make_synthetic_batch)
+from eksml_tpu.data.masks import (  # noqa: F401
+    polygons_to_bbox_mask, rle_decode, rle_encode)

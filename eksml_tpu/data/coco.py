@@ -1,0 +1,99 @@
+"""COCO-2017 dataset reader (no pycocotools dependency).
+
+Parity target: TensorPack's ``dataset/register_coco`` + COCODetection
+(external, container/Dockerfile:16-19), reading the directory layout the
+reference stages onto the shared filesystem:
+``<basedir>/{train2017,val2017}`` images and
+``<basedir>/annotations/instances_{split}.json``
+(eks-cluster/prepare-s3-bucket.sh:21-31, stage-data.yaml:30-36,
+charts/maskrcnn/values.yaml:13,17-18).
+
+Category ids are remapped to contiguous [1..80] exactly as pycocotools
+consumers do (sorted by original id); class 0 is background.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class CocoDataset:
+    def __init__(self, basedir: str, split: str,
+                 annotation_file: Optional[str] = None):
+        self.basedir = basedir
+        self.split = split
+        self.image_dir = os.path.join(basedir, split)
+        ann = annotation_file or os.path.join(
+            basedir, "annotations", f"instances_{split}.json")
+        with open(ann) as f:
+            data = json.load(f)
+
+        cats = sorted(data["categories"], key=lambda c: c["id"])
+        # original id → contiguous [1..K]
+        self.cat_id_to_class = {c["id"]: i + 1 for i, c in enumerate(cats)}
+        self.class_to_cat_id = {v: k for k, v in self.cat_id_to_class.items()}
+        self.class_names = ["BG"] + [c["name"] for c in cats]
+
+        self.images: Dict[int, Dict] = {im["id"]: im for im in data["images"]}
+        anns_by_image: Dict[int, List[Dict]] = {}
+        for a in data.get("annotations", []):
+            anns_by_image.setdefault(a["image_id"], []).append(a)
+        self.anns_by_image = anns_by_image
+        self.image_ids = sorted(self.images.keys())
+
+    def __len__(self) -> int:
+        return len(self.image_ids)
+
+    def record(self, image_id: int, with_anns: bool = True) -> Dict:
+        """One training record: path, size, boxes (xyxy), classes,
+        iscrowd flags, raw segmentations."""
+        im = self.images[image_id]
+        rec = {
+            "image_id": image_id,
+            "path": os.path.join(self.image_dir, im["file_name"]),
+            "height": im["height"],
+            "width": im["width"],
+        }
+        if not with_anns:
+            return rec
+        boxes, classes, iscrowd, segs = [], [], [], []
+        for a in self.anns_by_image.get(image_id, []):
+            if a.get("ignore", 0):
+                continue
+            x, y, w, h = a["bbox"]
+            x2 = min(x + w, im["width"])
+            y2 = min(y + h, im["height"])
+            x, y = max(x, 0), max(y, 0)
+            if x2 <= x + 1e-3 or y2 <= y + 1e-3:
+                continue
+            boxes.append([x, y, x2, y2])
+            classes.append(self.cat_id_to_class[a["category_id"]])
+            iscrowd.append(a.get("iscrowd", 0))
+            segs.append(a.get("segmentation"))
+        rec["boxes"] = np.asarray(boxes, np.float32).reshape(-1, 4)
+        rec["classes"] = np.asarray(classes, np.int32)
+        rec["iscrowd"] = np.asarray(iscrowd, np.int32)
+        rec["segmentation"] = segs
+        return rec
+
+    def records(self, with_anns: bool = True,
+                skip_empty: bool = True) -> List[Dict]:
+        out = []
+        for iid in self.image_ids:
+            r = self.record(iid, with_anns)
+            if with_anns and skip_empty and len(r["boxes"]) == 0:
+                continue
+            out.append(r)
+        return out
+
+
+def load_image(path: str) -> np.ndarray:
+    """Decode an image file → uint8 RGB [H, W, 3]."""
+    from PIL import Image
+
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))

@@ -1,0 +1,303 @@
+"""Static-shape detection batches with background prefetch.
+
+Replaces TensorPack's multiprocess DataFlow (external,
+container/Dockerfile:16-19) with a thread-prefetched loader whose
+output shapes are compile-time constants — the property XLA requires
+(SURVEY.md §7 hard part #1):
+
+- images resized so the short edge hits TRAIN_SHORT_EDGE_SIZE, long
+  edge capped at MAX_SIZE, then zero-padded to (MAX_SIZE, MAX_SIZE);
+- GT padded to MAX_GT_BOXES with a validity mask;
+- GT masks rasterized bbox-cropped at a fixed resolution;
+- per-host sharding: host i takes records [i::num_hosts] and every
+  host runs the same steps_per_epoch with wrap-around, so collective
+  step counts always agree across hosts (uneven shards deadlock,
+  SURVEY.md §7 hard part #4).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from eksml_tpu.data.masks import polygons_to_bbox_mask, rle_decode
+
+
+def resize_and_pad(image: np.ndarray, short_edge: int, max_size: int):
+    """Resize keeping aspect so short edge == short_edge (long edge
+    capped at max_size), then pad bottom/right to (max_size, max_size).
+
+    Returns (padded float32 image, scale, (new_h, new_w)).
+    """
+    h, w = image.shape[:2]
+    scale = short_edge / min(h, w)
+    if scale * max(h, w) > max_size:
+        scale = max_size / max(h, w)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    resized = _bilinear_resize(image.astype(np.float32), nh, nw)
+    out = np.zeros((max_size, max_size, image.shape[2]), np.float32)
+    out[:nh, :nw] = resized
+    return out, scale, (nh, nw)
+
+
+def _bilinear_resize(img: np.ndarray, nh: int, nw: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    yy = (np.arange(nh) + 0.5) * h / nh - 0.5
+    xx = (np.arange(nw) + 0.5) * w / nw - 0.5
+    y0 = np.clip(np.floor(yy).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xx).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    ly = np.clip(yy - y0, 0, 1)[:, None, None]
+    lx = np.clip(xx - x0, 0, 1)[None, :, None]
+    return (img[np.ix_(y0, x0)] * (1 - ly) * (1 - lx)
+            + img[np.ix_(y1, x0)] * ly * (1 - lx)
+            + img[np.ix_(y0, x1)] * (1 - ly) * lx
+            + img[np.ix_(y1, x1)] * ly * lx)
+
+
+class SyntheticDataset:
+    """Generated records for tests/benchmarks — fills the role of the
+    reference's absent fixtures (SURVEY.md §4: the reference can only
+    test on a live cluster; we can test anywhere)."""
+
+    def __init__(self, num_images: int = 64, height: int = 320,
+                 width: int = 320, max_boxes: int = 8, num_classes: int = 81,
+                 seed: int = 0):
+        self.rng = np.random.RandomState(seed)
+        self._records = []
+        for i in range(num_images):
+            n = self.rng.randint(1, max_boxes + 1)
+            xy = self.rng.rand(n, 2) * np.array([width, height]) * 0.6
+            wh = self.rng.rand(n, 2) * np.array([width, height]) * 0.3 + 8
+            boxes = np.concatenate(
+                [xy, np.minimum(xy + wh, [width - 1, height - 1])], axis=1)
+            self._records.append({
+                "image_id": i,
+                "path": None,
+                "height": height, "width": width,
+                "boxes": boxes.astype(np.float32),
+                "classes": self.rng.randint(1, num_classes, n).astype(np.int32),
+                "iscrowd": np.zeros(n, np.int32),
+                "segmentation": [None] * n,
+                "_image": self.rng.randint(
+                    0, 255, (height, width, 3)).astype(np.uint8),
+            })
+
+    def records(self, with_anns: bool = True, skip_empty: bool = True):
+        return list(self._records)
+
+
+class DetectionLoader:
+    """Iterates fixed-shape batches over (a shard of) a record list."""
+
+    def __init__(self, records: List[Dict], cfg, batch_size: int,
+                 is_training: bool = True, num_hosts: int = 1,
+                 host_id: int = 0, seed: int = 0,
+                 with_masks: bool = True, prefetch: int = 4,
+                 gt_mask_size: int = 56):
+        assert len(records) > 0, "empty dataset"
+        self.records = records[host_id::num_hosts]
+        if not self.records:  # more hosts than records (tiny smoke runs)
+            self.records = records[:1]
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.is_training = is_training
+        self.rng = np.random.RandomState(seed + host_id)
+        self.with_masks = with_masks
+        self.prefetch = prefetch
+        self.gt_mask_size = gt_mask_size
+        self.mean = np.asarray(cfg.PREPROC.PIXEL_MEAN, np.float32)
+        self.std = np.asarray(cfg.PREPROC.PIXEL_STD, np.float32)
+        self.max_gt = cfg.DATA.MAX_GT_BOXES
+        self._order = np.arange(len(self.records))
+        self._pos = 0
+
+    # -- single example -----------------------------------------------
+
+    def _load_example(self, rec: Dict) -> Dict[str, np.ndarray]:
+        if rec.get("_image") is not None:
+            image = rec["_image"]
+        else:
+            from eksml_tpu.data.coco import load_image
+            image = load_image(rec["path"])
+        boxes = rec["boxes"].copy()
+        classes = rec["classes"]
+        # crowd boxes are kept: the model treats them as ignore regions
+        # (never positives, and they veto background sampling near them)
+        crowd = rec["iscrowd"].astype(np.float32)
+        # order non-crowd first so MAX_GT truncation drops crowds first
+        order = np.argsort(crowd, kind="stable")
+        boxes, classes, crowd = boxes[order], classes[order], crowd[order]
+        segs = [rec["segmentation"][i] for i in order]
+
+        short_edges = self.cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE \
+            if self.is_training else (self.cfg.PREPROC.TEST_SHORT_EDGE_SIZE,) * 2
+        short = int(self.rng.randint(min(short_edges), max(short_edges) + 1))
+        max_size = self.cfg.PREPROC.MAX_SIZE
+        image_f, scale, (nh, nw) = resize_and_pad(image, short, max_size)
+        boxes = boxes * scale
+
+        if self.is_training and self.rng.rand() < 0.5:
+            image_f[:, :nw] = image_f[:, :nw][:, ::-1]
+            x1 = nw - boxes[:, 2]
+            x2 = nw - boxes[:, 0]
+            boxes = np.stack([x1, boxes[:, 1], x2, boxes[:, 3]], axis=1)
+            flipped = True
+        else:
+            flipped = False
+
+        image_f = (image_f - self.mean) / self.std
+
+        g = self.max_gt
+        n = min(len(boxes), g)
+        gt_boxes = np.zeros((g, 4), np.float32)
+        gt_classes = np.zeros((g,), np.int32)
+        gt_valid = np.zeros((g,), np.float32)
+        gt_crowd = np.zeros((g,), np.float32)
+        gt_boxes[:n] = boxes[:n]
+        gt_classes[:n] = classes[:n]
+        gt_valid[:n] = 1.0
+        gt_crowd[:n] = crowd[:n]
+
+        ex = {
+            "images": image_f,
+            "image_hw": np.asarray([nh, nw], np.float32),
+            "image_scale": np.float32(scale),
+            "image_id": np.int64(rec["image_id"]),
+            "gt_boxes": gt_boxes,
+            "gt_classes": gt_classes,
+            "gt_valid": gt_valid,
+            "gt_crowd": gt_crowd,
+        }
+        if self.with_masks:
+            ms = self.gt_mask_size
+            gt_masks = np.zeros((g, ms, ms), np.float32)
+            for i in range(n):
+                if crowd[i]:
+                    continue  # crowds are never mask-training targets
+                seg = segs[i] if i < len(segs) else None
+                gt_masks[i] = self._seg_to_crop(
+                    seg, rec, boxes[i] / scale, flipped, nw / scale)
+            ex["gt_masks"] = gt_masks
+        return ex
+
+    def _seg_to_crop(self, seg, rec, box, flipped, orig_w):
+        """Segmentation → bbox-cropped fixed-size binary mask.
+
+        ``box`` is the GT box mapped back to original image resolution;
+        when ``flipped`` it is already mirrored, so the segmentation is
+        mirrored about ``orig_w`` to match (crops are scale-invariant,
+        only the flip matters).
+        """
+        ms = self.gt_mask_size
+        if seg is None:
+            return np.ones((ms, ms), np.float32)  # synthetic: full box
+        if isinstance(seg, dict):  # RLE segmentation
+            full = rle_decode(seg, rec["height"], rec["width"])
+            if flipped:
+                full = full[:, ::-1]
+            m = _crop_resize_binary(full, box, ms)
+        else:
+            if flipped:
+                polys = [np.asarray(p, np.float64).reshape(-1, 2)
+                         for p in seg]
+                seg = [np.stack([orig_w - p[:, 0], p[:, 1]], 1).reshape(-1)
+                       for p in polys]
+            m = polygons_to_bbox_mask(seg, box, ms)
+        return m.astype(np.float32)
+
+    # -- iteration ----------------------------------------------------
+
+    def _next_indices(self) -> List[int]:
+        out = []
+        for _ in range(self.batch_size):
+            if self._pos == 0 and self.is_training:
+                self.rng.shuffle(self._order)
+            out.append(self._order[self._pos])
+            self._pos = (self._pos + 1) % len(self._order)
+        return out
+
+    def batches(self, num_steps: Optional[int] = None
+                ) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield ``num_steps`` batches (wrap-around; infinite if None)
+        through a background prefetch thread."""
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def put_or_stop(item) -> bool:
+            # stop-aware put: never blocks forever if the consumer left
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        error = []
+
+        def producer():
+            produced = 0
+            try:
+                while not stop.is_set() and (num_steps is None
+                                             or produced < num_steps):
+                    idx = self._next_indices()
+                    exs = [self._load_example(self.records[i]) for i in idx]
+                    batch = {k: np.stack([e[k] for e in exs])
+                             for k in exs[0].keys()}
+                    if not put_or_stop(batch):
+                        return
+                    produced += 1
+            except Exception as e:  # surfaced to the consumer below
+                error.append(e)
+            finally:
+                put_or_stop(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                batch = q.get()
+                if batch is None:
+                    if error:
+                        raise error[0]
+                    return
+                yield batch
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+
+def _crop_resize_binary(mask: np.ndarray, box, out_size: int) -> np.ndarray:
+    x1, y1, x2, y2 = box
+    h, w = mask.shape
+    ys = np.clip(((np.arange(out_size) + 0.5) / out_size * (y2 - y1) + y1)
+                 .astype(int), 0, h - 1)
+    xs = np.clip(((np.arange(out_size) + 0.5) / out_size * (x2 - x1) + x1)
+                 .astype(int), 0, w - 1)
+    return mask[np.ix_(ys, xs)]
+
+
+def make_synthetic_batch(cfg, batch_size: int = 1, image_size: int = 256,
+                         seed: int = 0, with_masks: bool = True,
+                         gt_mask_size: int = 56) -> Dict[str, np.ndarray]:
+    """One fixed batch for tests/bench/compile-checks."""
+    ds = SyntheticDataset(num_images=batch_size * 2, height=image_size,
+                          width=image_size,
+                          num_classes=cfg.DATA.NUM_CLASSES, seed=seed)
+    saved = cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE
+    cfg.freeze(False)
+    cfg.PREPROC.MAX_SIZE = image_size
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (image_size, image_size)
+    try:
+        loader = DetectionLoader(ds.records(), cfg, batch_size,
+                                 with_masks=with_masks, seed=seed,
+                                 gt_mask_size=gt_mask_size, prefetch=1)
+        return next(iter(loader.batches(1)))
+    finally:
+        cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = saved
+        cfg.freeze()

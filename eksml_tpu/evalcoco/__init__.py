@@ -1,0 +1,18 @@
+"""COCO mAP evaluation (no pycocotools dependency).
+
+The reference evaluates with pycocotools / NVIDIA cocoapi C extensions
+(container/Dockerfile:12, container-optimized/Dockerfile:17-23) driven
+by TensorPack's periodic-eval callback (TRAIN.EVAL_PERIOD=1 epoch,
+charts/maskrcnn/values.yaml:16).  Neither is available here, so this
+package implements COCOeval semantics directly: greedy score-ordered
+matching at IoU 0.50:0.95, crowd-as-ignore, area ranges, 101-point
+interpolated AP — with a C++ fast path for RLE mask IoU in ``native/``.
+
+Distributed: each host evaluates its shard of val2017; detections are
+gathered to the coordinator which runs the accumulate step
+(SURVEY.md §7 hard part #5 — the reference gets this free from
+single-rank eval).
+"""
+
+from eksml_tpu.evalcoco.cocoeval import COCOEvaluator  # noqa: F401
+from eksml_tpu.evalcoco.runner import make_eval_fn, run_evaluation  # noqa: F401

@@ -1,0 +1,278 @@
+"""COCOeval-semantics mAP computation in vectorized numpy.
+
+Implements the evaluation protocol of COCO's official toolkit (the
+C/Cython pycocotools the reference images install,
+container/Dockerfile:12): per-(image, category) greedy matching of
+score-sorted detections to GT at IoU thresholds 0.50:0.05:0.95, crowd
+GT as ignore regions (IoF overlap), area-range filtering, then
+accumulation into 101-point interpolated precision and the standard
+metric set (AP, AP50, AP75, APs/m/l, AR@100).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+IOU_THRESHS = np.linspace(0.5, 0.95, 10)
+RECALL_POINTS = np.linspace(0.0, 1.0, 101)
+AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0 ** 2),
+    "medium": (32.0 ** 2, 96.0 ** 2),
+    "large": (96.0 ** 2, 1e10),
+}
+
+
+def box_iou_xywh(dets: np.ndarray, gts: np.ndarray,
+                 gt_crowd: np.ndarray) -> np.ndarray:
+    """IoU matrix [D, G] for xywh boxes; crowd GT uses IoF
+    (intersection over detection area), per COCO convention."""
+    if len(dets) == 0 or len(gts) == 0:
+        return np.zeros((len(dets), len(gts)), np.float64)
+    d = dets[:, None, :]
+    g = gts[None, :, :]
+    ix = (np.minimum(d[..., 0] + d[..., 2], g[..., 0] + g[..., 2])
+          - np.maximum(d[..., 0], g[..., 0])).clip(min=0)
+    iy = (np.minimum(d[..., 1] + d[..., 3], g[..., 1] + g[..., 3])
+          - np.maximum(d[..., 1], g[..., 1])).clip(min=0)
+    inter = ix * iy
+    area_d = (d[..., 2] * d[..., 3])
+    area_g = (g[..., 2] * g[..., 3])
+    union = np.where(gt_crowd[None, :] > 0, area_d,
+                     area_d + area_g - inter)
+    return np.where(union > 0, inter / union, 0.0)
+
+
+def mask_iou(det_masks: Sequence, gt_masks: Sequence,
+             gt_crowd: np.ndarray) -> np.ndarray:
+    """IoU matrix for binary masks (numpy fallback; the C++ RLE path in
+    native/ is used when built — see evalcoco.native)."""
+    from eksml_tpu.evalcoco.native import mask_iou_native
+
+    out = mask_iou_native(det_masks, gt_masks, gt_crowd)
+    if out is not None:
+        return out
+    d_n, g_n = len(det_masks), len(gt_masks)
+    ious = np.zeros((d_n, g_n), np.float64)
+    for j in range(g_n):
+        g = gt_masks[j].astype(bool)
+        ga = g.sum()
+        for i in range(d_n):
+            d = det_masks[i].astype(bool)
+            inter = np.logical_and(d, g).sum()
+            if gt_crowd[j]:
+                union = d.sum()
+            else:
+                union = d.sum() + ga - inter
+            ious[i, j] = inter / union if union > 0 else 0.0
+    return ious
+
+
+class COCOEvaluator:
+    """Accumulates detections against a ground-truth record list.
+
+    ``gt_records``: list of dicts with image_id, boxes (xyxy, original
+    image coordinates), classes, iscrowd, areas, and (for segm)
+    full-image binary masks or callables producing them.
+    """
+
+    def __init__(self, gt_records: List[Dict], num_classes: int,
+                 iou_type: str = "bbox", max_dets: int = 100):
+        assert iou_type in ("bbox", "segm")
+        self.iou_type = iou_type
+        self.max_dets = max_dets
+        self.num_classes = num_classes
+        # index GT per (image, class)
+        self.gt: Dict = {}
+        self.image_ids = []
+        for rec in gt_records:
+            iid = rec["image_id"]
+            self.image_ids.append(iid)
+            boxes = np.asarray(rec["boxes"], np.float64).reshape(-1, 4)
+            xywh = np.stack([boxes[:, 0], boxes[:, 1],
+                             boxes[:, 2] - boxes[:, 0],
+                             boxes[:, 3] - boxes[:, 1]], axis=1)
+            classes = np.asarray(rec["classes"], np.int64)
+            crowd = np.asarray(rec.get("iscrowd",
+                                       np.zeros(len(classes))), np.int64)
+            areas = np.asarray(rec.get(
+                "areas", xywh[:, 2] * xywh[:, 3]), np.float64)
+            masks = rec.get("masks")
+            for c in np.unique(classes):
+                sel = classes == c
+                entry = {
+                    "xywh": xywh[sel], "crowd": crowd[sel],
+                    "area": areas[sel],
+                    "masks": ([masks[i] for i in np.nonzero(sel)[0]]
+                              if masks is not None else None),
+                }
+                self.gt[(iid, int(c))] = entry
+        self.dets: Dict = {}
+
+    def add_detections(self, image_id: int, boxes_xyxy: np.ndarray,
+                       scores: np.ndarray, classes: np.ndarray,
+                       masks: Optional[Sequence] = None) -> None:
+        """Register predictions for one image (original coordinates)."""
+        boxes_xyxy = np.asarray(boxes_xyxy, np.float64).reshape(-1, 4)
+        xywh = np.stack([boxes_xyxy[:, 0], boxes_xyxy[:, 1],
+                         boxes_xyxy[:, 2] - boxes_xyxy[:, 0],
+                         boxes_xyxy[:, 3] - boxes_xyxy[:, 1]], axis=1)
+        scores = np.asarray(scores, np.float64)
+        classes = np.asarray(classes, np.int64)
+        for c in np.unique(classes):
+            sel = classes == c
+            entry = self.dets.setdefault((image_id, int(c)),
+                                         {"xywh": [], "score": [],
+                                          "masks": []})
+            entry["xywh"].append(xywh[sel])
+            entry["score"].append(scores[sel])
+            if masks is not None:
+                entry["masks"].extend(
+                    [masks[i] for i in np.nonzero(sel)[0]])
+
+    # -- the match/accumulate pipeline --------------------------------
+
+    def _evaluate_pair(self, iid: int, cls: int):
+        """Greedy matching for one (image, class); returns per-det and
+        per-gt match info for all IoU thresholds."""
+        g = self.gt.get((iid, cls))
+        d = self.dets.get((iid, cls))
+        if g is None and d is None:
+            return None
+        g_xywh = g["xywh"] if g else np.zeros((0, 4))
+        g_crowd = g["crowd"] if g else np.zeros((0,), np.int64)
+        g_area = g["area"] if g else np.zeros((0,))
+        if d:
+            d_xywh = np.concatenate(d["xywh"])
+            d_score = np.concatenate(d["score"])
+        else:
+            d_xywh = np.zeros((0, 4))
+            d_score = np.zeros((0,))
+        order = np.argsort(-d_score, kind="mergesort")[: self.max_dets]
+        d_xywh, d_score = d_xywh[order], d_score[order]
+
+        if self.iou_type == "bbox":
+            ious = box_iou_xywh(d_xywh, g_xywh, g_crowd)
+        else:
+            d_masks = [d["masks"][i] for i in order] if d else []
+            ious = mask_iou(d_masks, g["masks"] if g else [], g_crowd)
+
+        T = len(IOU_THRESHS)
+        D, G = len(d_xywh), len(g_xywh)
+        # sort gt: non-crowd first (pycocotools sorts by ignore flag)
+        g_order = np.argsort(g_crowd, kind="mergesort")
+        dt_match = np.zeros((T, D), np.int64) - 1   # matched gt index
+        dt_crowd = np.zeros((T, D), bool)           # matched to crowd
+        gt_match = np.zeros((T, G), bool)
+        for t, thr in enumerate(IOU_THRESHS):
+            for di in range(D):
+                best = thr - 1e-10
+                best_g = -1
+                for gj in g_order:
+                    if gt_match[t, gj] and not g_crowd[gj]:
+                        continue
+                    # non-crowd match found; don't downgrade to crowd
+                    if best_g > -1 and not g_crowd[best_g] and g_crowd[gj]:
+                        break
+                    if ious[di, gj] < best:
+                        continue
+                    best = ious[di, gj]
+                    best_g = gj
+                if best_g >= 0:
+                    dt_match[t, di] = best_g
+                    dt_crowd[t, di] = bool(g_crowd[best_g])
+                    if not g_crowd[best_g]:
+                        gt_match[t, best_g] = True
+        return {
+            "score": d_score, "dt_match": dt_match, "dt_crowd": dt_crowd,
+            "dt_area": d_xywh[:, 2] * d_xywh[:, 3],
+            "gt_area": g_area, "gt_crowd": g_crowd.astype(bool),
+        }
+
+    def accumulate(self) -> Dict[str, float]:
+        classes = sorted({c for (_, c) in
+                          list(self.gt.keys()) + list(self.dets.keys())})
+        image_ids = sorted(set(self.image_ids))
+        T = len(IOU_THRESHS)
+        results = {}
+        # evaluate every (image, class) once
+        per_pair = {}
+        for c in classes:
+            for iid in image_ids:
+                r = self._evaluate_pair(iid, c)
+                if r is not None:
+                    per_pair[(iid, c)] = r
+
+        for range_name, (lo, hi) in AREA_RANGES.items():
+            ap_per_class = []
+            ar_per_class = []
+            for c in classes:
+                scores, matched, crowd_m, areas = [], [], [], []
+                n_gt = 0
+                for iid in image_ids:
+                    r = per_pair.get((iid, c))
+                    if r is None:
+                        continue
+                    g_ok = (~r["gt_crowd"] & (r["gt_area"] >= lo)
+                            & (r["gt_area"] < hi))
+                    n_gt += int(g_ok.sum())
+                    # det-level ignore: matched to crowd, or out of range
+                    d_in = (r["dt_area"] >= lo) & (r["dt_area"] < hi)
+                    # dets matched to out-of-range gt are ignored too
+                    gt_area_of_match = np.where(
+                        r["dt_match"] >= 0,
+                        r["gt_area"][np.clip(r["dt_match"], 0, None)]
+                        if len(r["gt_area"]) else 0.0, -1.0)
+                    ignore = r["dt_crowd"] | (
+                        (r["dt_match"] >= 0)
+                        & ((gt_area_of_match < lo)
+                           | (gt_area_of_match >= hi))) | (
+                        (r["dt_match"] < 0) & ~d_in[None, :])
+                    scores.append(r["score"])
+                    matched.append(r["dt_match"] >= 0)
+                    crowd_m.append(ignore)
+                    areas.append(d_in)
+                if n_gt == 0:
+                    continue
+                if scores:
+                    sc = np.concatenate(scores)
+                    order = np.argsort(-sc, kind="mergesort")
+                    m = np.concatenate(matched, axis=1)[:, order]
+                    ig = np.concatenate(crowd_m, axis=1)[:, order]
+                else:
+                    m = np.zeros((T, 0), bool)
+                    ig = np.zeros((T, 0), bool)
+                ap_t, ar_t = [], []
+                for t in range(T):
+                    keep = ~ig[t]
+                    tp = np.cumsum(m[t][keep])
+                    fp = np.cumsum(~m[t][keep])
+                    rec = tp / n_gt
+                    prec = tp / np.maximum(tp + fp, 1e-12)
+                    # monotone non-increasing interpolation
+                    for i in range(len(prec) - 1, 0, -1):
+                        prec[i - 1] = max(prec[i - 1], prec[i])
+                    idx = np.searchsorted(rec, RECALL_POINTS, side="left")
+                    p101 = np.where(idx < len(prec),
+                                    prec[np.clip(idx, 0, max(len(prec) - 1,
+                                                             0))], 0.0)
+                    ap_t.append(p101.mean() if len(prec) else 0.0)
+                    ar_t.append(rec[-1] if len(rec) else 0.0)
+                ap_per_class.append(ap_t)
+                ar_per_class.append(ar_t)
+            if ap_per_class:
+                ap = np.asarray(ap_per_class)  # [C, T]
+                ar = np.asarray(ar_per_class)
+                results[f"AP_{range_name}"] = float(ap.mean())
+                results[f"AR_{range_name}"] = float(ar.mean())
+                if range_name == "all":
+                    results["AP"] = float(ap.mean())
+                    results["AP50"] = float(ap[:, 0].mean())
+                    results["AP75"] = float(ap[:, 5].mean())
+            else:
+                results[f"AP_{range_name}"] = -1.0
+        for k in ("AP", "AP50", "AP75"):
+            results.setdefault(k, -1.0)
+        return results

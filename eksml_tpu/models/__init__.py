@@ -1,0 +1,17 @@
+"""Flax model zoo: ResNet-FPN Mask/Faster-RCNN (+ Cascade variant).
+
+Replaces the reference's external training codebases — TensorPack
+FasterRCNN @db541e8 (container/Dockerfile:16-19) and
+aws-samples/mask-rcnn-tensorflow @99dda64
+(container-optimized/Dockerfile:26-31) — with a TPU-first Flax
+implementation: static shapes end-to-end, bf16-ready, FrozenBN backbone
+initialized from the same ImageNet-R50-AlignPadding.npz the charts point
+at (charts/maskrcnn/values.yaml:22).
+"""
+
+from eksml_tpu.models.resnet import ResNetBackbone  # noqa: F401
+from eksml_tpu.models.fpn import FPN  # noqa: F401
+from eksml_tpu.models.rpn import RPNHead  # noqa: F401
+from eksml_tpu.models.heads import BoxHead, MaskHead  # noqa: F401
+from eksml_tpu.models.mask_rcnn import MaskRCNN  # noqa: F401
+from eksml_tpu.models.backbone_loader import load_r50_npz  # noqa: F401

@@ -1,0 +1,45 @@
+"""Feature Pyramid Network.
+
+Parity target: TensorPack ``modeling/model_fpn.py`` (external, pinned
+at container/Dockerfile:16-19) — lateral 1x1 + top-down upsample + 3x3
+output convs, P6 via max-pool stride 2 on P5 (used only by the RPN).
+All resolutions are static (padded image size / strides), so upsampling
+is a shape-constant `jnp.repeat` — cheap and fusible on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _upsample2x(x: jnp.ndarray) -> jnp.ndarray:
+    """Nearest-neighbor 2x upsample (static shapes)."""
+    b, h, w, c = x.shape
+    x = jnp.repeat(x, 2, axis=1)
+    return jnp.repeat(x, 2, axis=2)
+
+
+class FPN(nn.Module):
+    num_channels: int = 256
+
+    @nn.compact
+    def __call__(self, feats: Sequence[jnp.ndarray]) -> Tuple[jnp.ndarray, ...]:
+        """C2..C5 → (P2, P3, P4, P5, P6)."""
+        laterals = [
+            nn.Conv(self.num_channels, (1, 1), name=f"lateral_{i+2}")(c)
+            for i, c in enumerate(feats)
+        ]
+        # top-down pathway
+        merged = [laterals[-1]]
+        for lat in laterals[-2::-1]:
+            merged.append(lat + _upsample2x(merged[-1]))
+        merged = merged[::-1]  # P2..P5 order
+        outs = [
+            nn.Conv(self.num_channels, (3, 3), name=f"posthoc_{i+2}")(m)
+            for i, m in enumerate(merged)
+        ]
+        p6 = nn.max_pool(outs[-1], (1, 1), strides=(2, 2))
+        return tuple(outs) + (p6,)

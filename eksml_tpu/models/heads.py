@@ -1,0 +1,144 @@
+"""Second-stage heads: box classification/regression + mask prediction,
+plus proposal-target sampling and losses.
+
+Parity target: TensorPack ``modeling/model_frcnn.py`` /
+``model_mrcnn.py`` (external, container/Dockerfile:16-19).  TPU-first
+divergences: proposal-target sampling is a fixed-size top-k-on-random-
+priorities subsample inside jit (no host round-trip), and all losses are
+mask-weighted over static shapes (SURVEY.md §7 hard part #1).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from eksml_tpu.ops.boxes import encode_boxes, pairwise_iou
+from eksml_tpu.models.rpn import smooth_l1
+
+
+class BoxHead(nn.Module):
+    """2-FC head → per-class logits + class-agnostic-per-class deltas."""
+    num_classes: int = 81
+    fc_dim: int = 1024
+
+    @nn.compact
+    def __call__(self, roi_feats: jnp.ndarray):
+        # roi_feats: [N, P, P, C]
+        x = roi_feats.reshape(roi_feats.shape[0], -1)
+        x = nn.relu(nn.Dense(self.fc_dim, name="fc6")(x))
+        x = nn.relu(nn.Dense(self.fc_dim, name="fc7")(x))
+        logits = nn.Dense(self.num_classes, name="class")(x)
+        deltas = nn.Dense(self.num_classes * 4, name="box")(x)
+        return logits, deltas.reshape(-1, self.num_classes, 4)
+
+
+class MaskHead(nn.Module):
+    """4x conv3x3 + deconv2x + 1x1 per-class mask logits."""
+    num_classes: int = 81
+    dim: int = 256
+
+    @nn.compact
+    def __call__(self, roi_feats: jnp.ndarray):
+        x = roi_feats
+        for i in range(4):
+            x = nn.relu(nn.Conv(self.dim, (3, 3), name=f"fcn{i}")(x))
+        x = nn.relu(nn.ConvTranspose(self.dim, (2, 2), strides=(2, 2),
+                                     name="deconv")(x))
+        return nn.Conv(self.num_classes, (1, 1), name="conv")(x)
+
+
+def sample_proposal_targets(
+    proposals: jnp.ndarray,       # [P, 4]
+    proposal_scores: jnp.ndarray, # [P] (-inf padding)
+    gt_boxes: jnp.ndarray,        # [G, 4] padded
+    gt_classes: jnp.ndarray,      # [G] int, 0 = padding slot
+    gt_valid: jnp.ndarray,        # [G] 0/1
+    rng: jax.Array,
+    batch_per_im: int, fg_thresh: float, fg_ratio: float,
+    gt_crowd: jnp.ndarray = None,
+) -> Tuple[jnp.ndarray, ...]:
+    """Sample a fixed ``batch_per_im`` of proposals for head training.
+
+    Following standard practice (and TensorPack), GT boxes are added to
+    the proposal pool so there are always positives.  Crowd GT never
+    yields positives, and proposals mostly covered by a crowd region
+    are excluded from background sampling.  Returns
+    ``(rois [S,4], roi_labels [S] int, matched_gt [S] int,
+    fg_mask [S], valid_mask [S])`` with S = batch_per_im, all static.
+    """
+    from eksml_tpu.ops.sampling import sample_by_priority
+
+    crowd = jnp.zeros_like(gt_valid) if gt_crowd is None else gt_crowd
+    target_ok = (gt_valid > 0) & (crowd == 0)
+    pool_boxes = jnp.concatenate([proposals, gt_boxes], axis=0)
+    pool_valid = jnp.concatenate(
+        [jnp.isfinite(proposal_scores), target_ok], axis=0)
+    iou_all = pairwise_iou(pool_boxes, gt_boxes)
+    iou = iou_all * target_ok[None, :].astype(iou_all.dtype)
+    best_iou = iou.max(axis=1)
+    matched = iou.argmax(axis=1)
+    crowd_iou = (iou_all * ((gt_valid > 0) & (crowd > 0))[None, :]
+                 ).max(axis=1)
+
+    fg_cand = (best_iou >= fg_thresh) & pool_valid
+    bg_cand = (best_iou < fg_thresh) & pool_valid & (crowd_iou < fg_thresh)
+
+    max_fg = int(batch_per_im * fg_ratio)
+    rng_fg, rng_bg = jax.random.split(rng)
+    fg_idx, fg_take = sample_by_priority(fg_cand, rng_fg, max_fg)
+    num_bg = batch_per_im - fg_take.sum()
+    bg_idx, bg_take = sample_by_priority(bg_cand, rng_bg, batch_per_im,
+                                         limit=num_bg)
+
+    idx = jnp.concatenate([fg_idx, bg_idx], axis=0)  # [max_fg + batch]
+    take = jnp.concatenate([fg_take, bg_take], axis=0)
+    # compact to exactly batch_per_im slots: order fg first then bg, pad rest
+    order = jnp.argsort(~take)  # taken first, stable
+    idx = idx[order][:batch_per_im]
+    take = take[order][:batch_per_im]
+    is_fg = (jnp.arange(max_fg + batch_per_im)[order] < max_fg)[:batch_per_im]
+
+    rois = pool_boxes[idx]
+    matched_sel = matched[idx]
+    labels = jnp.where(is_fg & take, gt_classes[matched_sel], 0)
+    return rois, labels, matched_sel, is_fg & take, take
+
+
+def box_head_losses(logits, deltas, rois, roi_labels, matched_gt, gt_boxes,
+                    fg_mask, valid_mask, reg_weights):
+    """Softmax CE over sampled proposals + smooth-L1 on fg boxes,
+    normalized by the number of sampled proposals (TensorPack norm)."""
+    n_valid = jnp.maximum(valid_mask.sum(), 1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, roi_labels[:, None], axis=1)[:, 0]
+    cls_loss = jnp.where(valid_mask, ce, 0.0).sum() / n_valid
+
+    gt_for_roi = gt_boxes[matched_gt]
+    targets = encode_boxes(gt_for_roi, rois, reg_weights)
+    # per-class deltas: select the GT class channel
+    sel = jnp.take_along_axis(
+        deltas, roi_labels[:, None, None].clip(0), axis=1)[:, 0]
+    reg = smooth_l1(sel - targets, beta=1.0).sum(-1)
+    box_loss = jnp.where(fg_mask, reg, 0.0).sum() / n_valid
+    return cls_loss, box_loss
+
+
+def mask_head_loss(mask_logits, roi_labels, mask_targets, fg_mask):
+    """Per-fg-ROI BCE on the GT-class mask channel.
+
+    mask_logits [S, M, M, K]; mask_targets [S, M, M] in {0,1}.
+    """
+    import optax
+
+    k = mask_logits.shape[-1]
+    onehot = jax.nn.one_hot(roi_labels, k, dtype=mask_logits.dtype)
+    sel = jnp.einsum("shwk,sk->shw", mask_logits, onehot)
+    bce = optax.sigmoid_binary_cross_entropy(
+        sel, mask_targets.astype(sel.dtype))
+    per_roi = bce.mean(axis=(1, 2))
+    n_fg = jnp.maximum(fg_mask.sum(), 1)
+    return jnp.where(fg_mask, per_roi, 0.0).sum() / n_fg

@@ -1,0 +1,116 @@
+"""ResNet backbone with FrozenBN, TensorPack-compatible structure.
+
+Capability parity with TensorPack's ``modeling/backbone.py`` (external,
+pinned at container/Dockerfile:16-19): bottleneck ResNet-50/101, frozen
+batch-norm (``BACKBONE.NORM=FreezeBN``, reference run.sh:44), stages
+freezable up to ``FREEZE_AT`` (gradient-stopped rather than
+variable-partitioned — simpler under jit and equivalent under SGD), and
+channel ordering compatible with the ImageNet-R50-AlignPadding.npz
+checkpoint named in charts/maskrcnn/values.yaml:22.
+
+TPU notes: NHWC layout (XLA:TPU's native conv layout), bf16-friendly —
+the param dtype stays f32 while activations can be bf16 (mixed
+precision ≙ the optimized chart's TENSORPACK_FP16, charts/
+maskrcnn-optimized/templates/maskrcnn.yaml:47-48).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FrozenBN(nn.Module):
+    """Affine-only normalization with non-trainable statistics.
+
+    scale/bias/mean/var are stored as constants (loaded from the
+    pretrained npz); only folded scale+bias math runs per step.
+    """
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (c,), jnp.float32)
+        var = self.param("var", nn.initializers.ones, (c,), jnp.float32)
+        # fold into a single multiply-add; all four are stop-gradiented so
+        # "frozen" holds even when the surrounding stage is trainable
+        inv = jax.lax.stop_gradient(
+            scale * jax.lax.rsqrt(var + self.epsilon))
+        shift = jax.lax.stop_gradient(bias - mean * inv)
+        return x * inv.astype(x.dtype) + shift.astype(x.dtype)
+
+
+def _norm(norm: str):
+    if norm == "FreezeBN":
+        return FrozenBN()
+    if norm == "GN":
+        return nn.GroupNorm(num_groups=32, dtype=jnp.float32)
+    raise ValueError(norm)
+
+
+class Bottleneck(nn.Module):
+    channels: int
+    stride: int = 1
+    norm: str = "FreezeBN"
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        out = nn.Conv(self.channels, (1, 1), use_bias=False, name="conv1")(x)
+        out = _norm(self.norm)(out)
+        out = nn.relu(out)
+        out = nn.Conv(self.channels, (3, 3), strides=(self.stride, self.stride),
+                      use_bias=False, name="conv2")(out)
+        out = _norm(self.norm)(out)
+        out = nn.relu(out)
+        out = nn.Conv(self.channels * 4, (1, 1), use_bias=False,
+                      name="conv3")(out)
+        out = _norm(self.norm)(out)
+        if residual.shape != out.shape:
+            residual = nn.Conv(self.channels * 4, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, name="convshortcut")(x)
+            residual = _norm(self.norm)(residual)
+        return nn.relu(out + residual)
+
+
+class ResNetBackbone(nn.Module):
+    """Returns C2..C5 feature maps (strides 4, 8, 16, 32).
+
+    ``num_blocks=(3,4,6,3)`` → R50, ``(3,4,23,3)`` → R101
+    (config BACKBONE.RESNET_NUM_BLOCKS).
+    """
+    num_blocks: Sequence[int] = (3, 4, 6, 3)
+    norm: str = "FreezeBN"
+    freeze_at: int = 2  # freeze conv1+res2, TensorPack default
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jnp.ndarray, ...]:
+        # stem: 7x7/2 conv + 3x3/2 maxpool → stride 4
+        x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False,
+                    name="conv0")(x)
+        x = _norm(self.norm)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        feats = []
+        channels = (64, 128, 256, 512)
+        for stage, (blocks, ch) in enumerate(zip(self.num_blocks, channels)):
+            stride = 1 if stage == 0 else 2
+            for b in range(blocks):
+                x = Bottleneck(ch, stride=stride if b == 0 else 1,
+                               norm=self.norm,
+                               name=f"group{stage}_block{b}")(x)
+            # FREEZE_AT=2 freezes stem+res2 (stage 0) — implemented as a
+            # gradient stop, which under SGD(+wd on trainables only)
+            # equals TensorPack's variable freezing
+            if stage + 2 <= self.freeze_at:
+                x = jax.lax.stop_gradient(x)
+            feats.append(x)
+        return tuple(feats)  # C2, C3, C4, C5

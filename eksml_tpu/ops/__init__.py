@@ -1,0 +1,17 @@
+"""Detection ops (TPU-native, static shapes).
+
+Replaces the CUDA/cuDNN kernel layer of the reference stack (NMS,
+ROIAlign and box ops live in TensorPack's model code + TF CUDA kernels,
+pulled in via container/Dockerfile:1,16-19).  Everything here is
+expressed in XLA-friendly form — fixed shapes, vectorized gathers,
+`lax` control flow — with Pallas variants for hot kernels under
+``ops/pallas/``.
+"""
+
+from eksml_tpu.ops.boxes import (  # noqa: F401
+    area, clip_boxes, decode_boxes, encode_boxes, flip_boxes_horizontal,
+    pairwise_iou)
+from eksml_tpu.ops.anchors import generate_fpn_anchors  # noqa: F401
+from eksml_tpu.ops.nms import batched_nms, nms_mask  # noqa: F401
+from eksml_tpu.ops.roi_align import (  # noqa: F401
+    multilevel_roi_align, roi_align)

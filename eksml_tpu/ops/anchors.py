@@ -1,0 +1,55 @@
+"""Multi-level FPN anchor generation.
+
+Capability parity with TensorPack's ``modeling/model_fpn`` anchor logic
+(external repo pinned at container/Dockerfile:16-19).  Anchors are
+generated once per (static) padded image size at trace time — they are
+compile-time constants folded by XLA, so there is no per-step anchor
+cost on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def _cell_anchors(size: float, ratios: Sequence[float]) -> np.ndarray:
+    """Anchors centered at origin for one size across aspect ratios."""
+    out = []
+    for r in ratios:
+        w = size / np.sqrt(r)
+        h = size * np.sqrt(r)
+        out.append([-w / 2.0, -h / 2.0, w / 2.0, h / 2.0])
+    return np.asarray(out, dtype=np.float32)
+
+
+def generate_fpn_anchors(
+    image_size: Tuple[int, int],
+    strides: Sequence[int],
+    sizes: Sequence[float],
+    ratios: Sequence[float],
+) -> Tuple[np.ndarray, ...]:
+    """Per-level anchor arrays ``[(Hl*Wl*A, 4), ...]`` for a padded
+    ``image_size=(H, W)``; one size per level (config RPN.ANCHOR_SIZES
+    zipped with FPN.ANCHOR_STRIDES)."""
+    assert len(strides) == len(sizes)
+    H, W = image_size
+    levels = []
+    for stride, size in zip(strides, sizes):
+        fh, fw = H // stride, W // stride
+        cell = _cell_anchors(size, ratios)  # [A, 4]
+        shift_x = (np.arange(fw, dtype=np.float32) + 0.5) * stride
+        shift_y = (np.arange(fh, dtype=np.float32) + 0.5) * stride
+        sx, sy = np.meshgrid(shift_x, shift_y)
+        shifts = np.stack([sx, sy, sx, sy], axis=-1)  # [fh, fw, 4]
+        anchors = shifts[:, :, None, :] + cell[None, None, :, :]
+        levels.append(anchors.reshape(-1, 4).astype(np.float32))
+    return tuple(levels)
+
+
+def num_anchors_per_level(
+    image_size: Tuple[int, int], strides: Sequence[int], num_ratios: int
+) -> Tuple[int, ...]:
+    H, W = image_size
+    return tuple((H // s) * (W // s) * num_ratios for s in strides)

@@ -1,0 +1,95 @@
+"""Box ops: IoU, encode/decode, clipping, flipping.
+
+Equivalent capability to TensorPack FasterRCNN's ``modeling/model_box``
+(external, pinned at container/Dockerfile:16-19).  All functions are
+shape-polymorphic over leading dims and jit/vmap-friendly; boxes are
+``[..., 4]`` as (x1, y1, x2, y2) in image coordinates.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+EPS = 1e-8
+
+
+def area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Box areas, clamped at 0 for degenerate (padding) boxes."""
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0.0)
+    return w * h
+
+
+def pairwise_iou(boxes1: jnp.ndarray, boxes2: jnp.ndarray) -> jnp.ndarray:
+    """IoU matrix [..., N, M] for boxes1 [..., N, 4] × boxes2 [..., M, 4]."""
+    b1 = boxes1[..., :, None, :]
+    b2 = boxes2[..., None, :, :]
+    lt = jnp.maximum(b1[..., :2], b2[..., :2])
+    rb = jnp.minimum(b1[..., 2:], b2[..., 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area(boxes1)[..., :, None] + area(boxes2)[..., None, :] - inter
+    return inter / jnp.maximum(union, EPS)
+
+
+def encode_boxes(boxes: jnp.ndarray, anchors: jnp.ndarray,
+                 weights=(1.0, 1.0, 1.0, 1.0)) -> jnp.ndarray:
+    """Encode target ``boxes`` relative to ``anchors`` as (dx,dy,dw,dh).
+
+    Same parameterization as Faster-RCNN; ``weights`` are the
+    BBOX_REG_WEIGHTS the heads use (config FRCNN.BBOX_REG_WEIGHTS).
+    """
+    aw = jnp.maximum(anchors[..., 2] - anchors[..., 0], EPS)
+    ah = jnp.maximum(anchors[..., 3] - anchors[..., 1], EPS)
+    ax = anchors[..., 0] + 0.5 * aw
+    ay = anchors[..., 1] + 0.5 * ah
+    bw = jnp.maximum(boxes[..., 2] - boxes[..., 0], EPS)
+    bh = jnp.maximum(boxes[..., 3] - boxes[..., 1], EPS)
+    bx = boxes[..., 0] + 0.5 * bw
+    by = boxes[..., 1] + 0.5 * bh
+    wx, wy, ww, wh = weights
+    return jnp.stack([
+        wx * (bx - ax) / aw,
+        wy * (by - ay) / ah,
+        ww * jnp.log(bw / aw),
+        wh * jnp.log(bh / ah),
+    ], axis=-1)
+
+
+def decode_boxes(deltas: jnp.ndarray, anchors: jnp.ndarray,
+                 weights=(1.0, 1.0, 1.0, 1.0),
+                 clip_exp: float = 4.135) -> jnp.ndarray:
+    """Inverse of :func:`encode_boxes`; ``clip_exp`` bounds dw/dh
+    (log(1000/16), the standard cap) so padded/garbage deltas cannot
+    produce inf boxes that poison downstream static-shape ops."""
+    aw = jnp.maximum(anchors[..., 2] - anchors[..., 0], EPS)
+    ah = jnp.maximum(anchors[..., 3] - anchors[..., 1], EPS)
+    ax = anchors[..., 0] + 0.5 * aw
+    ay = anchors[..., 1] + 0.5 * ah
+    wx, wy, ww, wh = weights
+    dx = deltas[..., 0] / wx
+    dy = deltas[..., 1] / wy
+    dw = jnp.minimum(deltas[..., 2] / ww, clip_exp)
+    dh = jnp.minimum(deltas[..., 3] / wh, clip_exp)
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w, cy + 0.5 * h], axis=-1)
+
+
+def clip_boxes(boxes: jnp.ndarray, height, width) -> jnp.ndarray:
+    """Clip to [0,width]×[0,height]; height/width may be scalars or
+    broadcastable arrays (per-image true sizes inside the fixed pad)."""
+    x1 = jnp.clip(boxes[..., 0], 0, width)
+    y1 = jnp.clip(boxes[..., 1], 0, height)
+    x2 = jnp.clip(boxes[..., 2], 0, width)
+    y2 = jnp.clip(boxes[..., 3], 0, height)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def flip_boxes_horizontal(boxes: jnp.ndarray, width) -> jnp.ndarray:
+    x1 = width - boxes[..., 2]
+    x2 = width - boxes[..., 0]
+    return jnp.stack([x1, boxes[..., 1], x2, boxes[..., 3]], axis=-1)

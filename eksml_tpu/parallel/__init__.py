@@ -1,0 +1,18 @@
+"""Parallelism layer: mesh, distributed init, collectives.
+
+Replaces the reference's NCCL + Horovod + OpenMPI stack
+(SURVEY.md §5.8): rendezvous via JobSet stable DNS +
+``jax.distributed.initialize`` instead of mpirun/kubectl-delivery
+(charts/maskrcnn/templates/maskrcnn.yaml:47-55); collectives via XLA
+over ICI/DCN instead of NCCL rings (values.yaml:26-28); fusion tuning
+via XLA combine-threshold flags instead of HOROVOD_FUSION_THRESHOLD
+(values.yaml:24-25).  SPMD inverts the launcher-pushes-ranks model:
+every host runs the same program, the Mesh defines parallelism.
+"""
+
+from eksml_tpu.parallel.mesh import (  # noqa: F401
+    build_mesh, validate_topology, batch_sharding, replicated_sharding)
+from eksml_tpu.parallel.distributed import (  # noqa: F401
+    initialize_from_env, process_count, process_index)
+from eksml_tpu.parallel.collectives import (  # noqa: F401
+    cross_host_psum, param_fingerprint, set_xla_collective_flags)

@@ -1,0 +1,66 @@
+"""Multi-host rendezvous from JobSet environment.
+
+Replaces the reference's process-launch plumbing — mpirun over
+kubectl-exec (mpi-operator, SURVEY.md §3.2) or ssh keys
+(tensorpack.sh:10-14) — with ``jax.distributed.initialize``: the
+JobSet chart injects ``COORDINATOR_ADDRESS`` (stable headless-service
+DNS of replica 0), ``NUM_PROCESSES`` and ``PROCESS_ID`` (downward API
+``JOB_COMPLETION_INDEX``) into every pod; every pod runs the same
+program (SPMD) instead of a launcher pushing ranks.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_from_env(cfg=None) -> None:
+    """Call ``jax.distributed.initialize`` when the JobSet env says this
+    is a multi-process run; no-op (idempotent) otherwise.
+
+    Env contract (rendered by charts/maskrcnn/templates/jobset.yaml):
+      COORDINATOR_ADDRESS  host:port of replica 0
+      NUM_PROCESSES        total host processes
+      PROCESS_ID           this pod's index (JOB_COMPLETION_INDEX)
+    """
+    global _initialized
+    if _initialized:
+        return
+    if cfg is not None:
+        coord = cfg.TPU.COORDINATOR_ADDRESS
+        nproc = cfg.TPU.NUM_PROCESSES
+        pid = cfg.TPU.PROCESS_ID
+    else:
+        coord = os.environ.get("COORDINATOR_ADDRESS", "")
+        nproc = int(os.environ.get("NUM_PROCESSES", "1"))
+        pid = int(os.environ.get(
+            "PROCESS_ID", os.environ.get("JOB_COMPLETION_INDEX", "0")))
+    if nproc <= 1 or not coord:
+        log.info("single-process run (NUM_PROCESSES=%s)", nproc)
+        return
+    log.info("jax.distributed.initialize(%s, num_processes=%d, "
+             "process_id=%d)", coord, nproc, pid)
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=pid)
+    _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns logging/eval/checkpoint-metadata —
+    the role the reference gives the mpirun launcher pod (rank 0)."""
+    return jax.process_index() == 0

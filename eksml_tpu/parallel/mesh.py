@@ -1,0 +1,101 @@
+"""Device-mesh construction + slice-topology validation.
+
+The mesh is the TPU-native replacement for the reference's
+``gpus`` / ``gpus_per_node`` arithmetic: the MPIJob CRD validated
+``gpus ∈ {1,2,4} ∪ 8ℤ`` via an OpenAPI schema
+(charts/mpijob/templates/mpijob.yaml:16-50) and the mpi-operator split
+jobs with ``--gpus-per-node 8``
+(charts/maskrcnn/charts/mpi-operator/templates/mpi-operator.yaml:126-128).
+Here :func:`validate_topology` is that schema check re-expressed for
+v5e slices, and :func:`build_mesh` produces the
+``jax.sharding.Mesh`` all training code shards over.
+
+Data parallelism is the parity strategy (SURVEY.md §2c); the mesh
+always carries a ``model`` axis (size 1 by default) so tensor/other
+axes are addable without re-plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# v5e slice inventory: topology name → (chips, hosts).  A v5e host
+# carries 4 chips (the analogue of "8 GPUs per p3.16xlarge node",
+# eks-cluster/terraform/.../aws-eks-cluster-and-nodegroup.tf:75-79).
+V5E_TOPOLOGIES = {
+    "v5e-1": (1, 1),
+    "v5e-4": (4, 1),
+    "v5e-8": (8, 2),
+    "v5e-16": (16, 4),
+    "v5e-32": (32, 8),
+    "v5e-64": (64, 16),
+    "v5e-128": (128, 32),
+    "v5e-256": (256, 64),
+}
+
+
+def validate_topology(topology: str = "", num_chips: Optional[int] = None,
+                      chips_per_host: int = 4) -> Tuple[int, int]:
+    """Validate a requested slice the way the MPIJob CRD schema
+    validated ``gpus`` — fail before any pod/job is created.
+
+    Returns ``(num_chips, num_hosts)``.
+    """
+    if topology:
+        if topology not in V5E_TOPOLOGIES:
+            raise ValueError(
+                f"unknown TPU topology {topology!r}; valid: "
+                f"{sorted(V5E_TOPOLOGIES)}")
+        chips, hosts = V5E_TOPOLOGIES[topology]
+        if num_chips not in (None, chips):
+            raise ValueError(
+                f"TRAIN.NUM_CHIPS={num_chips} contradicts {topology} "
+                f"({chips} chips)")
+        return chips, hosts
+    if num_chips is None:
+        num_chips = len(jax.devices())
+    valid = num_chips in (1, 2) or (
+        num_chips % chips_per_host == 0 and num_chips > 0)
+    if not valid:
+        raise ValueError(
+            f"num_chips={num_chips} is not a valid v5e slice: need 1, 2, "
+            f"or a multiple of chips_per_host={chips_per_host}")
+    hosts = max(1, num_chips // chips_per_host)
+    return num_chips, hosts
+
+
+def build_mesh(mesh_shape: Sequence[int] = (),
+               axis_names: Sequence[str] = ("data", "model"),
+               devices=None) -> Mesh:
+    """Build the training mesh.
+
+    Default shape: all devices on the ``data`` axis, ``model`` axis 1 —
+    the DP layout that matches the reference's only strategy
+    (SURVEY.md §2c), with the model axis reserved for TP growth.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not mesh_shape:
+        mesh_shape = (n,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(mesh_shape)) != n:
+        raise ValueError(
+            f"mesh shape {tuple(mesh_shape)} needs {np.prod(mesh_shape)} "
+            f"devices, have {n}")
+    dev_array = np.asarray(devices).reshape(mesh_shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Sharding for per-step batches: leading dim split over ``data``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for parameters/optimizer state: full replica per chip —
+    the reference's layout (one Horovod model replica per GPU,
+    SURVEY.md §2c 'full replica per GPU')."""
+    return NamedSharding(mesh, P())

@@ -1,0 +1,4 @@
+"""Utilities: checkpointing, metrics, logging."""
+
+from eksml_tpu.utils.checkpoint import CheckpointManager  # noqa: F401
+from eksml_tpu.utils.metrics import MetricWriter  # noqa: F401

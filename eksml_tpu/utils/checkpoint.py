@@ -1,0 +1,55 @@
+"""Orbax checkpointing on a shared filesystem.
+
+Replaces the reference's TF ``model-<globalstep>.{index,data}``
+checkpoints written to EFS every TRAIN.CHECKPOINT_PERIOD epochs
+(charts/maskrcnn/values.yaml:29, templates/maskrcnn.yaml:58-59) and the
+filename-glob "latest" discovery the notebooks do (viz notebook cell 7).
+Orbax gives atomic multi-host writes and ``latest_step()`` natively;
+auto-resume-from-latest on re-entry is the behavior TPU preemption
+requires (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over ``ocp.CheckpointManager`` with a stable
+    directory contract: ``<logdir>/checkpoints/<step>/``."""
+
+    def __init__(self, logdir: str, max_to_keep: int = 5):
+        self.directory = os.path.join(os.path.abspath(logdir), "checkpoints")
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=True),
+        )
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        return self._mngr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure/shardings of ``state_like``."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            return None
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+        return self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()

@@ -1,0 +1,51 @@
+"""Metric writing: TensorBoard events + JSONL fallback.
+
+The reference's observability contract (SURVEY.md §5.5): scalars land
+as TF event files in the run logdir, served by the tensorboard subchart
+(charts/maskrcnn/charts/tensorboard/templates/tensorboard.yaml:46-49);
+stdout is teed per-rank.  Here: TensorBoard event files when a TB
+backend is importable, always-on JSONL (``metrics.jsonl``) so headless
+environments keep a machine-readable record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+class MetricWriter:
+    def __init__(self, logdir: str, enable_tensorboard: bool = True):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
+        self._tb = None
+        if enable_tensorboard:
+            try:
+                from flax.metrics import tensorboard
+
+                self._tb = tensorboard.SummaryWriter(logdir)
+            except Exception:
+                self._tb = None
+
+    def write_scalars(self, step: int, scalars: Dict[str, float]) -> None:
+        rec = {"step": int(step), "time": time.time()}
+        rec.update({k: float(v) for k, v in scalars.items()})
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.scalar(k, float(v), step)
+
+    def flush(self) -> None:
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()

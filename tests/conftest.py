@@ -1,0 +1,43 @@
+"""Test harness: 8-device virtual CPU mesh.
+
+The reference has zero automated tests (SURVEY.md §4); its multi-node
+path is only exercised on a live cluster.  Here the TPU-world "fake
+backend" is XLA's host-platform device-count override: every test sees 8
+CPU devices, so mesh/sharding/collective code paths compile and run
+without hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize pre-imports jax before this file runs, so the env vars
+# above may have been latched already — force the config directly too.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+
+
+@pytest.fixture()
+def fresh_config():
+    """A finalized config clone; tests mutate freely without leaking."""
+    from eksml_tpu import config as config_mod
+
+    saved = config_mod.config.to_dict()
+    config_mod.config.freeze(False)
+    yield config_mod.config
+    config_mod.config.freeze(False)
+    config_mod.config.from_dict(saved)
+    config_mod.config.freeze()

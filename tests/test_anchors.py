@@ -1,0 +1,34 @@
+"""Anchor-generation tests."""
+
+import numpy as np
+
+from eksml_tpu.ops import generate_fpn_anchors
+from eksml_tpu.ops.anchors import num_anchors_per_level
+
+
+def test_anchor_counts_and_shapes():
+    strides = (4, 8, 16, 32, 64)
+    sizes = (32, 64, 128, 256, 512)
+    ratios = (0.5, 1.0, 2.0)
+    anchors = generate_fpn_anchors((256, 256), strides, sizes, ratios)
+    assert len(anchors) == 5
+    counts = num_anchors_per_level((256, 256), strides, len(ratios))
+    for a, c, s in zip(anchors, counts, strides):
+        assert a.shape == (c, 4)
+        assert c == (256 // s) ** 2 * 3
+
+
+def test_anchor_geometry():
+    anchors, = generate_fpn_anchors((64, 64), (16,), (32,), (1.0,))
+    # first anchor centered at (8, 8) with 32x32 extent
+    np.testing.assert_allclose(anchors[0], [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+    # areas constant across ratios
+    anchors3, = generate_fpn_anchors((64, 64), (16,), (32,), (0.5, 1.0, 2.0))
+    areas = (anchors3[:3, 2] - anchors3[:3, 0]) * (anchors3[:3, 3] - anchors3[:3, 1])
+    np.testing.assert_allclose(areas, 32.0 * 32.0, rtol=1e-5)
+
+
+def test_anchor_grid_covers_image():
+    anchors, = generate_fpn_anchors((128, 128), (32,), (64,), (1.0,))
+    centers_x = (anchors[:, 0] + anchors[:, 2]) / 2
+    assert centers_x.min() == 16.0 and centers_x.max() == 112.0

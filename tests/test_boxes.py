@@ -1,0 +1,71 @@
+"""Box-op unit tests against numpy references."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from eksml_tpu.ops import (area, clip_boxes, decode_boxes, encode_boxes,
+                           flip_boxes_horizontal, pairwise_iou)
+
+
+def _rand_boxes(n, size=100.0):
+    xy = np.random.rand(n, 2) * size
+    wh = np.random.rand(n, 2) * size * 0.5 + 1.0
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def _np_iou(a, b):
+    out = np.zeros((len(a), len(b)), np.float32)
+    for i, bi in enumerate(a):
+        for j, bj in enumerate(b):
+            x1 = max(bi[0], bj[0]); y1 = max(bi[1], bj[1])
+            x2 = min(bi[2], bj[2]); y2 = min(bi[3], bj[3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            ai = (bi[2] - bi[0]) * (bi[3] - bi[1])
+            aj = (bj[2] - bj[0]) * (bj[3] - bj[1])
+            u = ai + aj - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+def test_pairwise_iou_matches_numpy():
+    a, b = _rand_boxes(13), _rand_boxes(7)
+    got = np.asarray(pairwise_iou(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, _np_iou(a, b), atol=1e-5)
+
+
+def test_iou_identity_and_disjoint():
+    b = _rand_boxes(5)
+    iou = np.asarray(pairwise_iou(jnp.asarray(b), jnp.asarray(b)))
+    np.testing.assert_allclose(np.diag(iou), 1.0, atol=1e-5)
+    far = b + 1000.0
+    iou2 = np.asarray(pairwise_iou(jnp.asarray(b), jnp.asarray(far)))
+    assert iou2.max() == 0.0
+
+
+def test_encode_decode_roundtrip():
+    anchors = _rand_boxes(20)
+    boxes = _rand_boxes(20)
+    weights = (10.0, 10.0, 5.0, 5.0)
+    deltas = encode_boxes(jnp.asarray(boxes), jnp.asarray(anchors), weights)
+    back = decode_boxes(deltas, jnp.asarray(anchors), weights)
+    np.testing.assert_allclose(np.asarray(back), boxes, atol=5e-3)
+
+
+def test_decode_caps_explosion():
+    anchors = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+    deltas = jnp.asarray([[0.0, 0.0, 100.0, 100.0]])  # garbage padding
+    out = np.asarray(decode_boxes(deltas, anchors))
+    assert np.isfinite(out).all()
+
+
+def test_clip_and_flip():
+    boxes = jnp.asarray([[-5.0, -5.0, 50.0, 120.0]])
+    clipped = np.asarray(clip_boxes(boxes, 100, 100))
+    np.testing.assert_allclose(clipped, [[0, 0, 50, 100]])
+    flipped = np.asarray(flip_boxes_horizontal(clipped, 100))
+    np.testing.assert_allclose(flipped, [[50, 0, 100, 100]])
+
+
+def test_area_padding_boxes_zero():
+    z = jnp.zeros((4, 4))
+    assert np.asarray(area(z)).sum() == 0.0

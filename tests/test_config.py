@@ -1,0 +1,68 @@
+"""Config-tree tests: dotted overrides, freeze, finalize derivations."""
+
+import pytest
+
+from eksml_tpu.config import AttrDict, config, finalize_configs
+
+
+def test_defaults_present():
+    assert config.MODE_MASK is True
+    assert config.RPN.ANCHOR_SIZES == (32, 64, 128, 256, 512)
+    assert config.TRAIN.STEPS_PER_EPOCH == 120000
+
+
+def test_update_args_literal_parsing(fresh_config):
+    fresh_config.update_args([
+        "MODE_MASK=False",
+        "TRAIN.LR_SCHEDULE=[240000,320000,360000]",
+        "DATA.BASEDIR=/efs/data",
+        "TRAIN.BASE_LR=0.02",
+    ])
+    assert fresh_config.MODE_MASK is False
+    assert fresh_config.TRAIN.LR_SCHEDULE == [240000, 320000, 360000]
+    assert fresh_config.DATA.BASEDIR == "/efs/data"
+    assert fresh_config.TRAIN.BASE_LR == 0.02
+
+
+def test_unknown_key_rejected(fresh_config):
+    with pytest.raises(KeyError):
+        fresh_config.update_args(["TRAIN.NO_SUCH_KEY=1"])
+    with pytest.raises(ValueError):
+        fresh_config.update_args(["NOT_AN_ASSIGNMENT"])
+
+
+def test_freeze_blocks_new_keys():
+    d = AttrDict()
+    d.A.B = 1
+    d.freeze()
+    with pytest.raises(AttributeError):
+        _ = d.A.C
+    d.freeze(False)
+    d.A.C = 2
+    assert d.A.C == 2
+
+
+def test_finalize_steps_per_epoch_scaling(fresh_config):
+    # reference contract: steps_per_epoch = 120000 / num chips
+    # (charts/maskrcnn/values.yaml:14, run.sh:15)
+    fresh_config.TRAIN.NUM_CHIPS = 16
+    finalize_configs(is_training=True)
+    assert fresh_config.TRAIN.STEPS_PER_EPOCH == 7500
+
+
+def test_finalize_epoch_lr_schedule(fresh_config):
+    # optimized-chart schedule [(16,0.1),(20,0.01),(24,None)]
+    # (charts/maskrcnn-optimized/values.yaml:18)
+    fresh_config.TRAIN.NUM_CHIPS = 16
+    fresh_config.TRAIN.LR_EPOCH_SCHEDULE = ((16, 0.1), (20, 0.01), (24, None))
+    finalize_configs(is_training=True)
+    assert fresh_config.TRAIN.LR_SCHEDULE == (16 * 7500, 20 * 7500)
+    assert fresh_config.TRAIN.MAX_EPOCHS == 24
+
+
+def test_roundtrip_dict(fresh_config):
+    d = fresh_config.to_dict()
+    assert d["RPN"]["BATCH_PER_IM"] == 256
+    clone = fresh_config.clone()
+    clone.RPN.BATCH_PER_IM = 512
+    assert fresh_config.RPN.BATCH_PER_IM == 256

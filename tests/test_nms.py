@@ -1,0 +1,87 @@
+"""NMS tests: fixed-shape greedy NMS vs a numpy greedy reference."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from eksml_tpu.ops import batched_nms, nms_mask
+from eksml_tpu.ops.nms import class_aware_nms
+
+
+def _np_greedy_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if not np.isfinite(scores[i]) or suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or suppressed[j]:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0]); yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2]); yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            b = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            u = a + b - inter
+            if u > 0 and inter / u > thresh and scores[j] < scores[i]:
+                suppressed[j] = True
+    return sorted(keep)
+
+
+def _rand_cluster_boxes(n):
+    # clusters of overlapping boxes so NMS actually suppresses
+    centers = np.random.rand(n // 4 + 1, 2) * 80
+    boxes = []
+    for _ in range(n):
+        c = centers[np.random.randint(len(centers))]
+        jitter = np.random.randn(2) * 3
+        wh = np.random.rand(2) * 20 + 10
+        xy = c + jitter
+        boxes.append([xy[0], xy[1], xy[0] + wh[0], xy[1] + wh[1]])
+    return np.asarray(boxes, np.float32)
+
+
+def test_nms_mask_matches_numpy():
+    n = 64
+    boxes = _rand_cluster_boxes(n)
+    scores = np.random.rand(n).astype(np.float32)
+    keep = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores), 0.5))
+    expected = _np_greedy_nms(boxes, scores, 0.5)
+    assert sorted(np.nonzero(keep)[0].tolist()) == expected
+
+
+def test_nms_padding_excluded():
+    boxes = np.zeros((8, 4), np.float32)
+    boxes[:2] = [[0, 0, 10, 10], [100, 100, 110, 110]]
+    scores = np.full(8, -np.inf, np.float32)
+    scores[:2] = [0.9, 0.8]
+    keep = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores), 0.5))
+    assert keep[:2].all() and not keep[2:].any()
+
+
+def test_batched_nms_shapes_and_validity():
+    b, k, m = 3, 32, 8
+    boxes = np.stack([_rand_cluster_boxes(k) for _ in range(b)])
+    scores = np.random.rand(b, k).astype(np.float32)
+    idx, top_scores, valid = batched_nms(jnp.asarray(boxes),
+                                         jnp.asarray(scores), 0.5, m)
+    assert idx.shape == (b, k)[:1] + (m,)
+    assert top_scores.shape == (b, m) and valid.shape == (b, m)
+    # top scores are descending where valid
+    ts = np.asarray(top_scores)
+    v = np.asarray(valid)
+    for i in range(b):
+        s = ts[i][v[i]]
+        assert (np.diff(s) <= 1e-6).all()
+
+
+def test_class_aware_nms_keeps_cross_class_overlaps():
+    boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], dtype=jnp.float32)
+    scores = jnp.asarray([0.9, 0.8])
+    cls = jnp.asarray([1, 2])
+    _, s, valid = class_aware_nms(boxes, scores, 0.5, 2, class_ids=cls)
+    assert np.asarray(valid).all()  # different classes → both kept
+    _, _, valid_same = class_aware_nms(boxes, scores, 0.5, 2,
+                                       class_ids=jnp.asarray([1, 1]))
+    assert np.asarray(valid_same).sum() == 1

@@ -1,0 +1,79 @@
+"""ROIAlign tests: analytic cases + numpy bilinear reference."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from eksml_tpu.ops import multilevel_roi_align, roi_align
+from eksml_tpu.ops.roi_align import assign_fpn_levels
+
+
+def _np_roi_align(feat, roi, scale, out, sr=2):
+    """Direct numpy transliteration of aligned=True ROIAlign for 1 ROI."""
+    H, W, C = feat.shape
+    x1, y1, x2, y2 = [v * scale for v in roi]
+    bw = max(x2 - x1, 1e-4) / out
+    bh = max(y2 - y1, 1e-4) / out
+    res = np.zeros((out, out, C), np.float32)
+    for by in range(out):
+        for bx in range(out):
+            acc = np.zeros(C, np.float32)
+            for iy in range(sr):
+                for ix in range(sr):
+                    y = y1 - 0.5 + (by + (iy + 0.5) / sr) * bh
+                    x = x1 - 0.5 + (bx + (ix + 0.5) / sr) * bw
+                    y0, x0 = int(np.floor(y)), int(np.floor(x))
+                    ly, lx = y - y0, x - x0
+                    for (yy, xx, w) in [(y0, x0, (1 - ly) * (1 - lx)),
+                                        (y0, x0 + 1, (1 - ly) * lx),
+                                        (y0 + 1, x0, ly * (1 - lx)),
+                                        (y0 + 1, x0 + 1, ly * lx)]:
+                        if 0 <= yy < H and 0 <= xx < W:
+                            acc += feat[yy, xx] * w
+            res[by, bx] = acc / (sr * sr)
+    return res
+
+
+def test_roi_align_matches_numpy():
+    feat = np.random.rand(16, 16, 3).astype(np.float32)
+    rois = np.asarray([[4.0, 4.0, 28.0, 20.0],
+                       [0.0, 0.0, 32.0, 32.0],
+                       [10.0, 6.0, 14.0, 30.0]], np.float32)
+    got = np.asarray(roi_align(jnp.asarray(feat), jnp.asarray(rois),
+                               spatial_scale=0.5, out_size=4))
+    for i, roi in enumerate(rois):
+        ref = _np_roi_align(feat, roi, 0.5, 4)
+        np.testing.assert_allclose(got[i], ref, atol=1e-4)
+
+
+def test_roi_align_constant_feature():
+    feat = jnp.full((8, 8, 1), 7.0)
+    rois = jnp.asarray([[1.0, 1.0, 6.0, 6.0]])
+    out = np.asarray(roi_align(feat, rois, 1.0, 2))
+    np.testing.assert_allclose(out, 7.0, atol=1e-5)
+
+
+def test_assign_fpn_levels():
+    rois = jnp.asarray([
+        [0, 0, 32, 32],      # small → P2
+        [0, 0, 112, 112],    # → P3
+        [0, 0, 224, 224],    # canonical → P4
+        [0, 0, 448, 448],    # → P5
+        [0, 0, 2000, 2000],  # huge → clipped at P5
+    ], dtype=jnp.float32)
+    lvls = np.asarray(assign_fpn_levels(rois))
+    np.testing.assert_array_equal(lvls, [2, 3, 4, 5, 5])
+
+
+def test_multilevel_matches_single_level():
+    """A ROI assigned to level l must produce exactly the single-level
+    result on that level's feature."""
+    strides = [4, 8, 16, 32]
+    H = 64
+    feats = [np.random.rand(H // s, H // s, 2).astype(np.float32)
+             for s in strides]
+    roi = np.asarray([[8.0, 8.0, 40.0, 40.0]], np.float32)  # 32px → P2
+    got = np.asarray(multilevel_roi_align(
+        [jnp.asarray(f) for f in feats], jnp.asarray(roi), strides, 4))
+    ref = np.asarray(roi_align(jnp.asarray(feats[0]), jnp.asarray(roi),
+                               1.0 / strides[0], 4))
+    np.testing.assert_allclose(got, ref, atol=1e-5)

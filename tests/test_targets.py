@@ -1,0 +1,85 @@
+"""Target-assignment tests: anchor matching, crowd handling, sampling."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from eksml_tpu.models.rpn import match_anchors, sample_anchors
+from eksml_tpu.models.heads import sample_proposal_targets
+from eksml_tpu.ops.sampling import sample_by_priority, sample_mask_by_priority
+
+
+def test_match_anchors_basic():
+    anchors = jnp.asarray([
+        [0, 0, 10, 10],      # matches gt0 exactly
+        [100, 100, 110, 110],  # far from everything → bg
+        [0, 0, 9, 10],       # high IoU with gt0
+    ], dtype=jnp.float32)
+    gt = jnp.asarray([[0, 0, 10, 10], [0, 0, 0, 0]], dtype=jnp.float32)
+    valid = jnp.asarray([1.0, 0.0])
+    labels, matched = match_anchors(anchors, gt, valid, 0.7, 0.3)
+    assert int(labels[0]) == 1
+    assert int(labels[1]) == 0
+    assert int(labels[2]) == 1
+    assert int(matched[0]) == 0
+
+
+def test_match_anchors_padding_never_positive():
+    anchors = jnp.asarray([[0, 0, 10, 10]], dtype=jnp.float32)
+    gt = jnp.zeros((3, 4))
+    valid = jnp.zeros(3)
+    labels, _ = match_anchors(anchors, gt, valid, 0.7, 0.3)
+    assert int(labels[0]) == 0  # no GT → everything bg, never fg
+
+
+def test_match_anchors_crowd_ignored_not_negative():
+    anchors = jnp.asarray([
+        [0, 0, 10, 10],        # overlaps the crowd region
+        [50, 50, 60, 60],      # overlaps real GT
+        [200, 200, 210, 210],  # clean background
+    ], dtype=jnp.float32)
+    gt = jnp.asarray([[0, 0, 10, 10], [50, 50, 60, 60]], dtype=jnp.float32)
+    valid = jnp.asarray([1.0, 1.0])
+    crowd = jnp.asarray([1.0, 0.0])
+    labels, matched = match_anchors(anchors, gt, valid, 0.7, 0.3,
+                                    gt_crowd=crowd)
+    assert int(labels[0]) == -1  # crowd overlap → ignore, not bg, not fg
+    assert int(labels[1]) == 1 and int(matched[1]) == 1
+    assert int(labels[2]) == 0
+
+
+def test_sample_by_priority_counts_and_limit():
+    cand = jnp.asarray([True] * 10 + [False] * 20)
+    idx, take = sample_by_priority(cand, jax.random.PRNGKey(0), 16)
+    assert int(take.sum()) == 10  # only 10 candidates exist
+    assert set(np.asarray(idx[np.asarray(take)])) <= set(range(10))
+    _, take2 = sample_by_priority(cand, jax.random.PRNGKey(0), 16,
+                                  limit=jnp.asarray(4))
+    assert int(take2.sum()) == 4
+
+
+def test_sample_anchors_respects_budget():
+    labels = jnp.asarray([1] * 5 + [0] * 500 + [-1] * 10)
+    fg, bg = sample_anchors(labels, jax.random.PRNGKey(1), 64, 0.5)
+    assert int(fg.sum()) == 5          # all fg kept (≤ 32)
+    assert int(bg.sum()) == 64 - 5     # bg fills the rest
+    assert not np.asarray(fg & bg).any()
+
+
+def test_sample_proposal_targets_static_shapes():
+    p = 20
+    props = jnp.asarray(np.random.rand(p, 4) * 50 +
+                        np.array([0, 0, 30, 30]), jnp.float32)
+    scores = jnp.where(jnp.arange(p) < 15, 0.5, -jnp.inf)
+    gt = jnp.asarray([[10, 10, 40, 40], [0, 0, 0, 0]], jnp.float32)
+    gt_cls = jnp.asarray([3, 0])
+    gt_valid = jnp.asarray([1.0, 0.0])
+    rois, labels, matched, fg, valid = sample_proposal_targets(
+        props, scores, gt, gt_cls, gt_valid, jax.random.PRNGKey(0),
+        batch_per_im=16, fg_thresh=0.5, fg_ratio=0.25)
+    assert rois.shape == (16, 4) and labels.shape == (16,)
+    assert int(fg.sum()) >= 1  # GT added to pool guarantees a positive
+    # fg rois carry the GT class, bg rois class 0
+    lab = np.asarray(labels)
+    assert (lab[np.asarray(fg)] == 3).all()
+    assert (lab[~np.asarray(fg)] == 0).all()
