@@ -1,0 +1,120 @@
+"""Benchmark: Mask-RCNN R50-FPN training throughput, images/sec/chip.
+
+Runs the real jitted train step (forward + backward + SGD update) on
+synthetic COCO-shaped data at the optimized-chart operating point —
+bf16 compute, batch 4 per chip (reference
+charts/maskrcnn-optimized/templates/maskrcnn.yaml:63,72) — on whatever
+accelerator jax finds (one TPU chip under the driver).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip",
+     "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
+is reported against the public TensorPack-era V100 figure of
+~20 img/s/GPU at batch 4 fp16 — the closest apples-to-apples anchor
+for the hardware the reference targets (2× p3.16xlarge).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+# Approximate per-V100 throughput of the reference's optimized stack
+# (aws-samples mask-rcnn-tensorflow, fp16, batch 4). Used only to give
+# vs_baseline a denominator; the reference repo itself publishes none.
+V100_IMAGES_PER_SEC = 20.0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="eksml_tpu throughput bench")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--image-size", type=int, default=1024)
+    p.add_argument("--precision", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--config", nargs="*", default=[],
+                   help="KEY=VALUE overrides")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from eksml_tpu.config import config as cfg
+    from eksml_tpu.data.loader import make_synthetic_batch
+    from eksml_tpu.models import MaskRCNN
+    from eksml_tpu.train import make_optimizer
+
+    cfg.freeze(False)
+    cfg.TRAIN.PRECISION = args.precision
+    cfg.TRAIN.BATCH_SIZE_PER_CHIP = args.batch_size
+    cfg.PREPROC.MAX_SIZE = args.image_size
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (args.image_size, args.image_size)
+    cfg.update_args(args.config)
+    cfg.freeze()
+
+    n_dev = len(jax.devices())
+    dev_kind = jax.devices()[0].device_kind
+    print(f"bench: {n_dev}x {dev_kind}, batch={args.batch_size}, "
+          f"image={args.image_size}, {args.precision}", file=sys.stderr)
+
+    model = MaskRCNN.from_config(cfg)
+    tx, _ = make_optimizer(cfg)
+
+    batch = make_synthetic_batch(cfg, batch_size=args.batch_size,
+                                 image_size=args.image_size)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+
+    rng = jax.random.PRNGKey(0)
+    t0 = time.time()
+    params = jax.jit(lambda r, b: model.init(r, b, r)["params"])(rng, batch)
+    opt_state = tx.init(params)
+    print(f"bench: init in {time.time() - t0:.1f}s", file=sys.stderr)
+
+    def train_step(params, opt_state, batch, rng):
+        def loss_fn(p):
+            losses = model.apply({"params": p}, batch, rng)
+            return losses["total_loss"], losses
+
+        grads, losses = jax.grad(loss_fn, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_opt,
+                losses["total_loss"])
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    t0 = time.time()
+    for i in range(args.warmup):
+        params, opt_state, loss = step(params, opt_state, batch,
+                                       jax.random.fold_in(rng, i))
+    jax.block_until_ready(loss)
+    print(f"bench: compile+warmup in {time.time() - t0:.1f}s "
+          f"(loss={float(loss):.3f})", file=sys.stderr)
+
+    t0 = time.time()
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, batch,
+                                       jax.random.fold_in(rng, 100 + i))
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    assert np.isfinite(float(loss)), f"non-finite loss {float(loss)}"
+    imgs_per_sec = args.steps * args.batch_size / dt
+    per_chip = imgs_per_sec / max(1, n_dev)
+    print(json.dumps({
+        "metric": "maskrcnn_r50fpn_train_throughput",
+        "value": round(per_chip, 3),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / V100_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -261,20 +261,28 @@ def finalize_configs(is_training: bool) -> AttrDict:
         _C.DATA.TRAIN = (_C.DATA.TRAIN,)
 
     if is_training:
-        # Reference couples steps/epoch to world size: 120000/N
-        # (values.yaml:14, run.sh:15).  Recompute rather than trust the
-        # caller, but only when the caller left the single-chip default.
-        if _C.TRAIN.STEPS_PER_EPOCH == 120000 and _C.TRAIN.NUM_CHIPS > 1:
-            _C.TRAIN.STEPS_PER_EPOCH = 120000 // _C.TRAIN.NUM_CHIPS
+        # Reference couples steps/epoch to world size: 120000/N at batch
+        # 1 (values.yaml:14, run.sh:15); the optimized chart divides by
+        # the global batch (--images_per_epoch 120000 at batch 4,
+        # charts/maskrcnn-optimized/templates/maskrcnn.yaml:64,72).
+        # Recompute only when the caller left the single-chip default.
+        global_batch = _C.TRAIN.NUM_CHIPS * _C.TRAIN.BATCH_SIZE_PER_CHIP
+        if _C.TRAIN.STEPS_PER_EPOCH == 120000 and global_batch > 1:
+            _C.TRAIN.STEPS_PER_EPOCH = 120000 // global_batch
         if _C.TRAIN.LR_EPOCH_SCHEDULE:
             # optimized-chart form [(16,0.1),(20,0.01),(24,None)]
-            # (charts/maskrcnn-optimized/values.yaml:18) → step boundaries.
+            # (charts/maskrcnn-optimized/values.yaml:18) → boundaries in
+            # LR_SCHEDULE's batch-8-convention steps (lr_schedule in
+            # train.py rescales by 8/global_batch, so express epochs in
+            # those units to survive the round trip at any batch).
             sched = []
             for epoch, mult in _C.TRAIN.LR_EPOCH_SCHEDULE:
                 if mult is None:
                     _C.TRAIN.MAX_EPOCHS = epoch
                 else:
-                    sched.append(epoch * _C.TRAIN.STEPS_PER_EPOCH)
+                    sched.append(max(1, round(
+                        epoch * _C.TRAIN.STEPS_PER_EPOCH
+                        * global_batch / 8)))
             _C.TRAIN.LR_SCHEDULE = tuple(sched)
 
     _C.freeze()

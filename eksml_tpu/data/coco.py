@@ -60,7 +60,7 @@ class CocoDataset:
         }
         if not with_anns:
             return rec
-        boxes, classes, iscrowd, segs = [], [], [], []
+        boxes, classes, iscrowd, segs, areas = [], [], [], [], []
         for a in self.anns_by_image.get(image_id, []):
             if a.get("ignore", 0):
                 continue
@@ -74,10 +74,13 @@ class CocoDataset:
             classes.append(self.cat_id_to_class[a["category_id"]])
             iscrowd.append(a.get("iscrowd", 0))
             segs.append(a.get("segmentation"))
+            # segmentation area, the quantity COCOeval buckets by
+            areas.append(a.get("area", (x2 - x) * (y2 - y)))
         rec["boxes"] = np.asarray(boxes, np.float32).reshape(-1, 4)
         rec["classes"] = np.asarray(classes, np.int32)
         rec["iscrowd"] = np.asarray(iscrowd, np.int32)
         rec["segmentation"] = segs
+        rec["area"] = np.asarray(areas, np.float64)
         return rec
 
     def records(self, with_anns: bool = True,

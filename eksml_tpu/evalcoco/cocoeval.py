@@ -47,8 +47,16 @@ def box_iou_xywh(dets: np.ndarray, gts: np.ndarray,
 
 def mask_iou(det_masks: Sequence, gt_masks: Sequence,
              gt_crowd: np.ndarray) -> np.ndarray:
-    """IoU matrix for binary masks (numpy fallback; the C++ RLE path in
-    native/ is used when built — see evalcoco.native)."""
+    """IoU matrix for binary masks.  Accepts dense [H, W] arrays or COCO
+    RLE dicts ({'size': [h, w], 'counts': [...]}); RLE stays compressed
+    end-to-end through the C++ path (evalcoco/native_src/maskops.cc),
+    the format pycocotools' C extension works in."""
+    if len(det_masks) == 0 or len(gt_masks) == 0:
+        return np.zeros((len(det_masks), len(gt_masks)), np.float64)
+    if isinstance(det_masks[0], dict) or isinstance(gt_masks[0], dict):
+        from eksml_tpu.evalcoco.native import rle_iou_masks
+
+        return rle_iou_masks(det_masks, gt_masks, gt_crowd)
     from eksml_tpu.evalcoco.native import mask_iou_native
 
     out = mask_iou_native(det_masks, gt_masks, gt_crowd)
@@ -209,7 +217,7 @@ class COCOEvaluator:
             ap_per_class = []
             ar_per_class = []
             for c in classes:
-                scores, matched, crowd_m, areas = [], [], [], []
+                scores, matched, crowd_m = [], [], []
                 n_gt = 0
                 for iid in image_ids:
                     r = per_pair.get((iid, c))
@@ -233,7 +241,6 @@ class COCOEvaluator:
                     scores.append(r["score"])
                     matched.append(r["dt_match"] >= 0)
                     crowd_m.append(ignore)
-                    areas.append(d_in)
                 if n_gt == 0:
                     continue
                 if scores:
@@ -249,6 +256,10 @@ class COCOEvaluator:
                     keep = ~ig[t]
                     tp = np.cumsum(m[t][keep])
                     fp = np.cumsum(~m[t][keep])
+                    if len(tp) == 0:  # GT exists, no detections kept
+                        ap_t.append(0.0)
+                        ar_t.append(0.0)
+                        continue
                     rec = tp / n_gt
                     prec = tp / np.maximum(tp + fp, 1e-12)
                     # monotone non-increasing interpolation

@@ -1,0 +1,132 @@
+// Native mask ops for COCO evaluation — the C/C++ hot spot of the
+// reference's eval stack (pycocotools' C extension, reference
+// container/Dockerfile:12; NVIDIA cocoapi compiled at
+// container-optimized/Dockerfile:17-23), reimplemented standalone.
+//
+// Exposed via a plain C ABI and loaded with ctypes
+// (eksml_tpu/evalcoco/native.py).  Three entry points:
+//   mask_iou_dense  — IoU matrix over dense uint8 masks, crowd-as-IoF
+//   rle_encode_dense — dense mask → run-length counts (column-major,
+//                      pycocotools order)
+//   rle_iou         — IoU matrix over run-length encoded masks
+//
+// Build: make -C eksml_tpu/evalcoco/native_src   (g++ only, no deps)
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// dets: [n_det, h*w] uint8, gts: [n_gt, h*w] uint8, crowd: [n_gt] uint8
+// out:  [n_det, n_gt] double
+void mask_iou_dense(const uint8_t* dets, int64_t n_det,
+                    const uint8_t* gts, int64_t n_gt,
+                    const uint8_t* crowd, int64_t hw, double* out) {
+  std::vector<int64_t> det_area(n_det), gt_area(n_gt);
+  for (int64_t i = 0; i < n_det; ++i) {
+    int64_t a = 0;
+    const uint8_t* p = dets + i * hw;
+    for (int64_t k = 0; k < hw; ++k) a += p[k] != 0;
+    det_area[i] = a;
+  }
+  for (int64_t j = 0; j < n_gt; ++j) {
+    int64_t a = 0;
+    const uint8_t* p = gts + j * hw;
+    for (int64_t k = 0; k < hw; ++k) a += p[k] != 0;
+    gt_area[j] = a;
+  }
+  for (int64_t i = 0; i < n_det; ++i) {
+    const uint8_t* d = dets + i * hw;
+    for (int64_t j = 0; j < n_gt; ++j) {
+      const uint8_t* g = gts + j * hw;
+      int64_t inter = 0;
+      for (int64_t k = 0; k < hw; ++k) inter += (d[k] && g[k]);
+      double uni = crowd[j] ? (double)det_area[i]
+                            : (double)(det_area[i] + gt_area[j] - inter);
+      out[i * n_gt + j] = uni > 0 ? (double)inter / uni : 0.0;
+    }
+  }
+}
+
+// mask: [h, w] uint8 row-major.  counts_out must hold h*w+1 entries.
+// Returns the number of counts written.  Column-major traversal with
+// alternating 0-run/1-run lengths — pycocotools' RLE convention.
+int64_t rle_encode_dense(const uint8_t* mask, int64_t h, int64_t w,
+                         uint32_t* counts_out) {
+  int64_t n = 0;
+  uint8_t cur = 0;
+  uint32_t run = 0;
+  for (int64_t x = 0; x < w; ++x) {
+    for (int64_t y = 0; y < h; ++y) {
+      uint8_t v = mask[y * w + x] != 0;
+      if (v == cur) {
+        ++run;
+      } else {
+        counts_out[n++] = run;
+        cur = v;
+        run = 1;
+      }
+    }
+  }
+  counts_out[n++] = run;
+  return n;
+}
+
+// RLE-vs-RLE intersection area (counts alternate 0-run, 1-run).
+static int64_t rle_inter(const uint32_t* a, int64_t na, const uint32_t* b,
+                         int64_t nb) {
+  int64_t ia = 0, ib = 0, inter = 0;
+  int64_t ca = ia < na ? a[0] : 0, cb = ib < nb ? b[0] : 0;
+  uint8_t va = 0, vb = 0;
+  while (ia < na && ib < nb) {
+    int64_t step = ca < cb ? ca : cb;
+    if (va && vb) inter += step;
+    ca -= step;
+    cb -= step;
+    if (ca == 0) {
+      ++ia;
+      va ^= 1;
+      if (ia < na) ca = a[ia];
+    }
+    if (cb == 0) {
+      ++ib;
+      vb ^= 1;
+      if (ib < nb) cb = b[ib];
+    }
+  }
+  return inter;
+}
+
+static int64_t rle_area(const uint32_t* c, int64_t n) {
+  int64_t a = 0;
+  for (int64_t i = 1; i < n; i += 2) a += c[i];
+  return a;
+}
+
+// Flattened RLE lists: counts concatenated; offsets[i]..offsets[i+1]
+// delimit mask i.  out: [n_det, n_gt] double.
+void rle_iou(const uint32_t* det_counts, const int64_t* det_off,
+             int64_t n_det, const uint32_t* gt_counts,
+             const int64_t* gt_off, int64_t n_gt, const uint8_t* crowd,
+             double* out) {
+  std::vector<int64_t> det_area(n_det), gt_area(n_gt);
+  for (int64_t i = 0; i < n_det; ++i)
+    det_area[i] = rle_area(det_counts + det_off[i],
+                           det_off[i + 1] - det_off[i]);
+  for (int64_t j = 0; j < n_gt; ++j)
+    gt_area[j] = rle_area(gt_counts + gt_off[j], gt_off[j + 1] - gt_off[j]);
+  for (int64_t i = 0; i < n_det; ++i) {
+    const uint32_t* dc = det_counts + det_off[i];
+    int64_t dn = det_off[i + 1] - det_off[i];
+    for (int64_t j = 0; j < n_gt; ++j) {
+      int64_t inter = rle_inter(dc, dn, gt_counts + gt_off[j],
+                                gt_off[j + 1] - gt_off[j]);
+      double uni = crowd[j] ? (double)det_area[i]
+                            : (double)(det_area[i] + gt_area[j] - inter);
+      out[i * n_gt + j] = uni > 0 ? (double)inter / uni : 0.0;
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,209 @@
+"""Periodic COCO evaluation driver (distributed-aware).
+
+Fills the role of TensorPack's periodic-eval callback
+(``TRAIN.EVAL_PERIOD=1`` epoch, reference charts/maskrcnn/values.yaml:16
+rendered at templates/maskrcnn.yaml:66): run the detector over val2017,
+compute box/mask AP, surface the scalars to TensorBoard.
+
+Distributed protocol (SURVEY.md §7 hard part #5 — the reference gets
+this free from single-rank eval): every host predicts its shard of the
+val set with the SAME number of batches (shards are padded, padding
+rows carry image_id -1), detections are all-gathered as fixed-shape
+arrays, and the coordinator runs the accumulate step.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eksml_tpu.data.loader import resize_and_pad
+from eksml_tpu.data.masks import paste_mask, polygon_fill, rle_decode, \
+    rle_encode
+
+log = logging.getLogger(__name__)
+
+
+def _gt_full_mask(rec: Dict, idx: int) -> np.ndarray:
+    """Rasterize GT annotation ``idx`` to a full-image binary mask."""
+    seg = rec["segmentation"][idx]
+    h, w = rec["height"], rec["width"]
+    if seg is None:
+        x1, y1, x2, y2 = rec["boxes"][idx].astype(int)
+        m = np.zeros((h, w), np.uint8)
+        m[max(y1, 0):y2, max(x1, 0):x2] = 1
+        return m
+    if isinstance(seg, dict):
+        return rle_decode(seg, h, w)
+    m = np.zeros((h, w), np.uint8)
+    for poly in seg:
+        p = np.asarray(poly, np.float64).reshape(-1, 2)
+        m |= polygon_fill(p, h, w)
+    return m
+
+
+def build_gt_records(records: List[Dict], with_masks: bool) -> List[Dict]:
+    """Evaluator GT format: original-coordinate boxes + (RLE) masks.
+    Areas come from the segmentation when present (COCO convention)."""
+    out = []
+    for rec in records:
+        entry = {
+            "image_id": rec["image_id"],
+            "boxes": rec["boxes"],
+            "classes": rec["classes"],
+            "iscrowd": rec["iscrowd"],
+        }
+        if "area" in rec:
+            entry["areas"] = rec["area"]
+        if with_masks:
+            masks = []
+            for i in range(len(rec["boxes"])):
+                masks.append(rle_encode(_gt_full_mask(rec, i)))
+            entry["masks"] = masks
+        out.append(entry)
+    return out
+
+
+def make_predict_fn(model) -> Callable:
+    """Jitted fixed-shape inference step: (params, images, hw) → dets."""
+    return jax.jit(lambda params, images, hw: model.apply(
+        {"params": params}, images, hw, method=type(model).predict))
+
+
+def run_evaluation(model, params, cfg, records: List[Dict],
+                   batch_size: int = 1,
+                   max_images: Optional[int] = None,
+                   predict_fn: Optional[Callable] = None) -> Dict[str, float]:
+    """Evaluate ``model(params)`` on COCO ``records``; returns AP dict.
+
+    Every host predicts records[host_id::num_hosts]; fixed-shape
+    detection arrays are all-gathered and host 0's accumulate result is
+    returned on all hosts (harmless recompute elsewhere).
+    """
+    from eksml_tpu.evalcoco.cocoeval import COCOEvaluator
+
+    t0 = time.time()
+    with_masks = bool(cfg.MODE_MASK)
+    if max_images:
+        records = records[:max_images]
+    num_hosts = jax.process_count()
+    host_id = jax.process_index()
+    shard = records[host_id::num_hosts]
+
+    # every host must run the same number of batches: pad with repeats,
+    # marked invalid via image_id -1 so their detections are dropped
+    per_host = max((len(records) + num_hosts - 1) // num_hosts, 1)
+    n_batches = (per_host + batch_size - 1) // batch_size
+    padded = list(shard) + [None] * (n_batches * batch_size - len(shard))
+
+    if predict_fn is None:
+        predict_fn = make_predict_fn(model)
+
+    max_size = cfg.PREPROC.MAX_SIZE
+    short = cfg.PREPROC.TEST_SHORT_EDGE_SIZE
+    mean = np.asarray(cfg.PREPROC.PIXEL_MEAN, np.float32)
+    std = np.asarray(cfg.PREPROC.PIXEL_STD, np.float32)
+
+    from eksml_tpu.data.coco import load_image
+
+    all_dets = []  # per-image dicts of fixed-shape numpy arrays
+    for b in range(n_batches):
+        chunk = padded[b * batch_size:(b + 1) * batch_size]
+        images = np.zeros((batch_size, max_size, max_size, 3), np.float32)
+        hw = np.ones((batch_size, 2), np.float32)
+        scales = np.ones(batch_size, np.float32)
+        ids = np.full(batch_size, -1, np.int64)
+        for i, rec in enumerate(chunk):
+            if rec is None:
+                continue
+            img = (rec["_image"] if rec.get("_image") is not None
+                   else load_image(rec["path"]))
+            im, scale, (nh, nw) = resize_and_pad(img, short, max_size)
+            images[i] = (im - mean) / std
+            hw[i] = (nh, nw)
+            scales[i] = scale
+            ids[i] = rec["image_id"]
+        out = predict_fn(params, jnp.asarray(images), jnp.asarray(hw))
+        out = jax.tree.map(np.asarray, out)
+        for i in range(batch_size):
+            det = {
+                "image_id": ids[i],
+                "boxes": out["boxes"][i] / scales[i],
+                "scores": out["scores"][i],
+                "classes": out["classes"][i],
+                "valid": out["valid"][i],
+            }
+            if with_masks and "masks" in out:
+                det["masks"] = out["masks"][i]
+            all_dets.append(det)
+
+    if num_hosts > 1:
+        from jax.experimental import multihost_utils
+
+        stacked = {k: np.stack([d[k] for d in all_dets])
+                   for k in all_dets[0]}
+        gathered = multihost_utils.process_allgather(stacked)
+        n_img = gathered["image_id"].shape[0] * gathered["image_id"].shape[1]
+        flat = {k: v.reshape((n_img,) + v.shape[2:])
+                for k, v in gathered.items()}
+        all_dets = [{k: flat[k][i] for k in flat} for i in range(n_img)]
+
+    results: Dict[str, float] = {}
+    if jax.process_index() == 0 or num_hosts == 1:
+        by_id = {rec["image_id"]: rec for rec in records}
+        gt = build_gt_records(records, with_masks)
+        bbox_ev = COCOEvaluator(gt, cfg.DATA.NUM_CLASSES, "bbox",
+                                max_dets=cfg.TEST.RESULTS_PER_IM)
+        segm_ev = (COCOEvaluator(gt, cfg.DATA.NUM_CLASSES, "segm",
+                                 max_dets=cfg.TEST.RESULTS_PER_IM)
+                   if with_masks else None)
+        for det in all_dets:
+            iid = int(det["image_id"])
+            rec = by_id.get(iid)
+            if rec is None:
+                continue  # padding row
+            keep = det["valid"] > 0
+            boxes = det["boxes"][keep]
+            scores = det["scores"][keep]
+            classes = det["classes"][keep]
+            bbox_ev.add_detections(iid, boxes, scores, classes)
+            if segm_ev is not None:
+                h, w = rec["height"], rec["width"]
+                rles = [rle_encode(paste_mask(m, b, h, w))
+                        for m, b in zip(det["masks"][keep], boxes)]
+                segm_ev.add_detections(iid, boxes, scores, classes,
+                                       masks=rles)
+        for name, ev in (("bbox", bbox_ev), ("segm", segm_ev)):
+            if ev is None:
+                continue
+            for k, v in ev.accumulate().items():
+                results[f"{name}/{k}"] = v
+        log.info("eval: %d images in %.1fs — bbox AP %.4f%s",
+                 len(records), time.time() - t0,
+                 results.get("bbox/AP", -1),
+                 (f", segm AP {results['segm/AP']:.4f}"
+                  if "segm/AP" in results else ""))
+    return results
+
+
+def make_eval_fn(cfg) -> Callable:
+    """Eval hook for the Trainer: (model, params, step) → metric dict."""
+    from eksml_tpu.data.coco import CocoDataset
+
+    state = {}
+
+    def eval_fn(model, params, step):
+        if "records" not in state:
+            ds = CocoDataset(cfg.DATA.BASEDIR, cfg.DATA.VAL)
+            state["records"] = ds.records(skip_empty=False)
+        return run_evaluation(
+            model, params, cfg, state["records"],
+            predict_fn=state.setdefault("predict_fn",
+                                        make_predict_fn(model)))
+
+    return eval_fn

@@ -32,18 +32,20 @@ def set_xla_collective_flags(combine_threshold_bytes: int) -> None:
         os.environ["LIBTPU_INIT_ARGS"] = (flags + add).strip()
 
 
-def cross_host_psum(tree, mesh: Mesh, axis: str = "data"):
-    """Explicit psum of a host-local pytree over the mesh axis — used
-    for metric aggregation (loss means, eval detection counts), the
-    role Horovod's allreduce served outside the gradient path."""
-    from jax import shard_map
+def cross_host_sum(tree):
+    """Sum a pytree of *host-local* metric values across all processes
+    (loss sums, eval detection counts) — the role Horovod's allreduce
+    served outside the gradient path.  Uses a host-side allgather, not
+    an in-program collective: each process may pass different values,
+    which a replicated shard_map input could not express.  Identity in
+    single-process runs."""
+    tree = jax.tree.map(jnp.asarray, tree)
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
 
-    def _sum(x):
-        return jax.lax.psum(x, axis)
-
-    fn = shard_map(lambda t: jax.tree.map(_sum, t), mesh=mesh,
-                   in_specs=P(), out_specs=P(), check_rep=False)
-    return fn(tree)
+    gathered = multihost_utils.process_allgather(tree)
+    return jax.tree.map(lambda x: x.sum(axis=0), gathered)
 
 
 def param_fingerprint(params) -> jnp.ndarray:
@@ -74,7 +76,7 @@ def assert_replicas_in_sync(params, mesh: Mesh, axis: str = "data",
         return jnp.stack([mine, theirs, low])
 
     out = shard_map(check, mesh=mesh, in_specs=P(), out_specs=P(None),
-                    check_rep=False)(fp)
+                    check_vma=False)(fp)
     mine, high, low = np.asarray(out)
     if abs(high - low) > atol:
         raise AssertionError(

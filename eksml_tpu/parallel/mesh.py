@@ -81,11 +81,20 @@ def build_mesh(mesh_shape: Sequence[int] = (),
     n = len(devices)
     if not mesh_shape:
         mesh_shape = (n,) + (1,) * (len(axis_names) - 1)
-    if int(np.prod(mesh_shape)) != n:
+    need = int(np.prod(mesh_shape))
+    if need > n:
         raise ValueError(
-            f"mesh shape {tuple(mesh_shape)} needs {np.prod(mesh_shape)} "
-            f"devices, have {n}")
-    dev_array = np.asarray(devices).reshape(mesh_shape)
+            f"mesh shape {tuple(mesh_shape)} needs {need} devices, "
+            f"have {n}")
+    if need < n and jax.process_count() > 1:
+        # a subset mesh would leave some hosts' devices unrepresented —
+        # their jit calls fail or hang at the first collective
+        raise ValueError(
+            f"mesh shape {tuple(mesh_shape)} covers {need} of {n} "
+            f"devices; subset meshes are only valid single-process")
+    # an explicit smaller mesh uses a device subset (single-chip smoke
+    # runs on a multi-device host)
+    dev_array = np.asarray(devices[:need]).reshape(mesh_shape)
     return Mesh(dev_array, tuple(axis_names))
 
 

@@ -1,0 +1,12 @@
+"""Inference surface: OfflinePredictor equivalent + visualization.
+
+Replaces the flow of the reference's viz notebooks
+(container-viz/notebooks/mask-rcnn-tensorpack-viz.ipynb cells 7-27):
+latest-checkpoint discovery, ``OfflinePredictor(PredictConfig(...))``,
+``predict_image``, ``draw_final_outputs`` — re-expressed as a jitted
+Flax forward restored from Orbax.
+"""
+
+from eksml_tpu.predict.predictor import (OfflinePredictor,  # noqa: F401
+                                         DetectionResult, predict_image)
+from eksml_tpu.predict.viz import draw_final_outputs  # noqa: F401

@@ -1,0 +1,127 @@
+"""Checkpoint → jitted single/batch-image detector.
+
+Reference flow being replaced (viz notebook, cells 7/9/11/23):
+  cell 7   glob model-*.index → max step            → Orbax latest_step()
+  cell 9   finalize_configs(is_training=False)      → same call here
+  cell 11  OfflinePredictor(PredictConfig(model, get_model_loader(ckpt),
+             input/output names))                   → OfflinePredictor
+  cell 23  predict_image(img, predictor)            → predict_image
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class DetectionResult:
+    """One detection in original-image coordinates — the analogue of
+    TensorPack's ``DetectionResult`` namedtuple the notebooks unpack."""
+    box: np.ndarray          # xyxy, float32
+    score: float
+    class_id: int
+    mask: Optional[np.ndarray] = None   # full-image uint8, or None
+
+
+class OfflinePredictor:
+    """Builds the jitted predict function once; call repeatedly."""
+
+    def __init__(self, cfg, params=None, checkpoint_dir: Optional[str] = None,
+                 checkpoint_step: Optional[int] = None):
+        from eksml_tpu.models import MaskRCNN
+
+        self.cfg = cfg
+        self.model = MaskRCNN.from_config(cfg)
+        if params is None:
+            if not checkpoint_dir:
+                raise ValueError("need params or checkpoint_dir")
+            params = self._restore_params(checkpoint_dir, checkpoint_step)
+        self.params = params
+        self._predict = jax.jit(
+            lambda p, images, hw: self.model.apply(
+                {"params": p}, images, hw, method=MaskRCNN.predict))
+
+        self.mean = np.asarray(cfg.PREPROC.PIXEL_MEAN, np.float32)
+        self.std = np.asarray(cfg.PREPROC.PIXEL_STD, np.float32)
+
+    # -- checkpoint ----------------------------------------------------
+
+    def _restore_params(self, logdir: str, step: Optional[int]):
+        """Restore the params subtree of a saved TrainState, rebuilding
+        the state skeleton the Trainer checkpoints (train.py)."""
+        from eksml_tpu.data.loader import make_synthetic_batch
+        from eksml_tpu.train import TrainState, make_optimizer
+        from eksml_tpu.utils import CheckpointManager
+
+        ckpt = CheckpointManager(logdir)
+        step = ckpt.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {logdir}")
+        log.info("restoring checkpoint step %d from %s", step, logdir)
+        batch = make_synthetic_batch(self.cfg, batch_size=1, image_size=128)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()
+                 if k not in ("image_scale", "image_id")}
+        rng = jax.random.PRNGKey(0)
+        params = jax.eval_shape(
+            lambda: self.model.init(rng, batch, rng)["params"])
+        params = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), params)
+        tx, _ = make_optimizer(self.cfg)
+        skeleton = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=tx.init(params), rng=rng)
+        restored = ckpt.restore(skeleton, step=step)
+        return restored.params
+
+    # -- prediction ----------------------------------------------------
+
+    def _preprocess(self, image: np.ndarray):
+        from eksml_tpu.data.loader import resize_and_pad
+
+        im, scale, (nh, nw) = resize_and_pad(
+            image, self.cfg.PREPROC.TEST_SHORT_EDGE_SIZE,
+            self.cfg.PREPROC.MAX_SIZE)
+        return (im - self.mean) / self.std, scale, (nh, nw)
+
+    def __call__(self, image: np.ndarray,
+                 score_thresh: Optional[float] = None
+                 ) -> List[DetectionResult]:
+        """Single-image inference in original coordinates."""
+        from eksml_tpu.data.masks import paste_mask
+
+        h, w = image.shape[:2]
+        im, scale, _ = self._preprocess(image)
+        hw = np.asarray([[im.shape[0], im.shape[1]]], np.float32)
+        out = self._predict(self.params, jnp.asarray(im[None]),
+                            jnp.asarray(hw))
+        out = jax.tree.map(np.asarray, out)
+        thresh = (self.cfg.TEST.RESULT_SCORE_THRESH
+                  if score_thresh is None else score_thresh)
+        results = []
+        for i in range(out["boxes"].shape[1]):
+            if out["valid"][0, i] <= 0 or out["scores"][0, i] < thresh:
+                continue
+            box = out["boxes"][0, i] / scale
+            box = np.clip(box, 0, [w, h, w, h]).astype(np.float32)
+            mask = None
+            if "masks" in out:
+                mask = paste_mask(out["masks"][0, i], box, h, w)
+            results.append(DetectionResult(
+                box=box, score=float(out["scores"][0, i]),
+                class_id=int(out["classes"][0, i]), mask=mask))
+        results.sort(key=lambda r: -r.score)
+        return results
+
+
+def predict_image(img: np.ndarray,
+                  predictor: OfflinePredictor) -> List[DetectionResult]:
+    """Same call shape as TensorPack's ``predict_image(img, pred)``
+    (viz notebook cell 23)."""
+    return predictor(img)

@@ -60,13 +60,17 @@ def lr_schedule(cfg) -> optax.Schedule:
     """Warmup + piecewise-constant decay.
 
     Reproduces the reference semantics: linear warmup then ×0.1 drops at
-    TRAIN.LR_SCHEDULE step boundaries (charts/maskrcnn/values.yaml:15 /
-    run.sh:42), with the base LR linearly scaled by global batch
-    (the reference scales implicitly via steps_per_epoch=120000/N).
+    TRAIN.LR_SCHEDULE boundaries, with the base LR linearly scaled by
+    global batch.  Boundary numbers follow the TensorPack convention the
+    charts use: steps *at global batch 8*, rescaled here to actual
+    steps — this is what makes values.yaml:15's [240000,320000,360000]
+    @16 GPUs and run.sh:42's [120000,160000,180000] @8 GPUs land on the
+    same image counts.
     """
     global_batch = cfg.TRAIN.NUM_CHIPS * cfg.TRAIN.BATCH_SIZE_PER_CHIP
     base = cfg.TRAIN.BASE_LR * global_batch / 8.0
-    boundaries = {int(s): 0.1 for s in cfg.TRAIN.LR_SCHEDULE}
+    boundaries = {max(1, int(s * 8 / global_batch)): 0.1
+                  for s in cfg.TRAIN.LR_SCHEDULE}
     main = optax.piecewise_constant_schedule(base, boundaries)
     warm = cfg.TRAIN.WARMUP_STEPS
     if warm <= 0:
@@ -80,13 +84,29 @@ def lr_schedule(cfg) -> optax.Schedule:
     return sched
 
 
-def _decay_mask(params):
-    """Weight decay on conv/dense kernels only — biases and (frozen)
-    norm params excluded, matching the reference models' wd scope."""
-    def mask(path, leaf):
-        return path[-1].key == "kernel"
+def _decay_mask(freeze_at: int):
+    """Weight decay on *trainable* conv/dense kernels only — biases,
+    norm params, and frozen backbone stages excluded.  The frozen
+    stages get zero gradient (stop_gradient in the backbone), so any
+    decay on them would silently shrink the pretrained weights."""
+    def mask_fn(params):
+        def mask(path, leaf):
+            if path[-1].key != "kernel":
+                return False
+            keys = [p.key for p in path]
+            if keys[0] == "backbone":
+                name = keys[1]
+                if name == "conv0" and freeze_at >= 1:
+                    return False
+                if name.startswith("group"):
+                    stage = int(name[len("group")])
+                    if stage + 2 <= freeze_at:
+                        return False
+            return True
 
-    return jax.tree_util.tree_map_with_path(mask, params)
+        return jax.tree_util.tree_map_with_path(mask, params)
+
+    return mask_fn
 
 
 def make_optimizer(cfg):
@@ -98,7 +118,8 @@ def make_optimizer(cfg):
         chain.append(optax.clip_by_global_norm(cfg.TRAIN.GRADIENT_CLIP))
     if cfg.TRAIN.WEIGHT_DECAY > 0:
         chain.append(optax.add_decayed_weights(
-            cfg.TRAIN.WEIGHT_DECAY, mask=_decay_mask))
+            cfg.TRAIN.WEIGHT_DECAY,
+            mask=_decay_mask(cfg.BACKBONE.FREEZE_AT)))
     chain.append(optax.sgd(sched, momentum=cfg.TRAIN.MOMENTUM))
     return optax.chain(*chain), sched
 
@@ -306,8 +327,19 @@ def main(argv=None):
 
     from eksml_tpu.data import DetectionLoader, SyntheticDataset
 
-    per_host_batch = (cfg.TRAIN.BATCH_SIZE_PER_CHIP *
-                      max(1, len(jax.local_devices())))
+    eval_fn = None
+    if not cfg.DATA.SYNTHETIC:
+        from eksml_tpu.evalcoco import make_eval_fn
+
+        eval_fn = make_eval_fn(cfg)
+
+    trainer = Trainer(cfg, cfg.TRAIN.LOGDIR, eval_fn=eval_fn)
+    # batch sizing follows the mesh, not local_devices(): a subset mesh
+    # (single-chip smoke on a multi-device host) must not inflate the
+    # per-host batch
+    local_chips = sum(d.process_index == jax.process_index()
+                      for d in trainer.mesh.devices.flat)
+    per_host_batch = cfg.TRAIN.BATCH_SIZE_PER_CHIP * max(1, local_chips)
     if cfg.DATA.SYNTHETIC:
         records = SyntheticDataset(
             num_images=64, height=cfg.PREPROC.MAX_SIZE,
@@ -328,13 +360,6 @@ def main(argv=None):
     total_steps = (args.total_steps if args.total_steps is not None
                    else cfg.TRAIN.STEPS_PER_EPOCH * cfg.TRAIN.MAX_EPOCHS)
 
-    eval_fn = None
-    if not cfg.DATA.SYNTHETIC:
-        from eksml_tpu.evalcoco import make_eval_fn
-
-        eval_fn = make_eval_fn(cfg)
-
-    trainer = Trainer(cfg, cfg.TRAIN.LOGDIR, eval_fn=eval_fn)
     trainer.fit(loader.batches(None), total_steps)
     log.info("training complete at %d steps", total_steps)
 

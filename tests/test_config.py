@@ -56,7 +56,10 @@ def test_finalize_epoch_lr_schedule(fresh_config):
     fresh_config.TRAIN.NUM_CHIPS = 16
     fresh_config.TRAIN.LR_EPOCH_SCHEDULE = ((16, 0.1), (20, 0.01), (24, None))
     finalize_configs(is_training=True)
-    assert fresh_config.TRAIN.LR_SCHEDULE == (16 * 7500, 20 * 7500)
+    # boundaries land in LR_SCHEDULE's batch-8-step convention:
+    # epoch 16 ≙ 16 × 120000 images ≙ 16 × 15000 batch-8 steps
+    # (train.lr_schedule rescales by 8/global_batch back to real steps)
+    assert fresh_config.TRAIN.LR_SCHEDULE == (16 * 15000, 20 * 15000)
     assert fresh_config.TRAIN.MAX_EPOCHS == 24
 
 

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from eksml_tpu.parallel import (batch_sharding, build_mesh, cross_host_psum,
+from eksml_tpu.parallel import (batch_sharding, build_mesh, cross_host_sum,
                                 param_fingerprint, replicated_sharding,
                                 validate_topology)
 from eksml_tpu.parallel.collectives import assert_replicas_in_sync
@@ -35,9 +35,13 @@ def test_build_mesh_default_dp():
     assert mesh.axis_names == ("data", "model")
 
 
-def test_build_mesh_shape_mismatch():
+def test_build_mesh_device_subset_and_overflow():
+    # a smaller explicit mesh takes a device subset (single-chip smoke
+    # on a multi-device host); more devices than exist still raises
+    m = build_mesh(mesh_shape=(4, 1))
+    assert m.devices.shape == (4, 1)
     with pytest.raises(ValueError):
-        build_mesh(mesh_shape=(4, 1))
+        build_mesh(mesh_shape=(16, 1))
 
 
 def test_sharded_batch_and_replicated_params():
@@ -70,12 +74,13 @@ def test_jit_inserts_allreduce_for_mean_over_sharded_batch():
     assert g.sharding.is_fully_replicated
 
 
-def test_cross_host_psum():
-    mesh = build_mesh()
-    tree = {"a": jnp.asarray(2.0), "b": jnp.asarray([1.0, 3.0])}
-    out = cross_host_psum(tree, mesh)
-    np.testing.assert_allclose(float(out["a"]), 16.0)  # 2.0 × 8 devices
-    np.testing.assert_allclose(np.asarray(out["b"]), [8.0, 24.0])
+def test_cross_host_sum_single_process_identity():
+    # 8 virtual devices but ONE process: host-local metrics sum over
+    # processes, so the value must come back unchanged
+    tree = {"a": 2.0, "b": jnp.asarray([1.0, 3.0])}
+    out = cross_host_sum(tree)
+    np.testing.assert_allclose(float(out["a"]), 2.0)
+    np.testing.assert_allclose(np.asarray(out["b"]), [1.0, 3.0])
 
 
 def test_replica_sync_check():
