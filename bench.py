@@ -32,7 +32,9 @@ V100_IMAGES_PER_SEC = 20.0
 def main(argv=None):
     p = argparse.ArgumentParser(description="eksml_tpu throughput bench")
     p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--warmup", type=int, default=3)
+    # at least 1: the first call compiles and must stay out of timing
+    p.add_argument("--warmup", type=int, default=3,
+                   choices=None, metavar="N")
     p.add_argument("--batch-size", type=int, default=4)
     p.add_argument("--image-size", type=int, default=1024)
     p.add_argument("--precision", default="bfloat16",
@@ -91,7 +93,7 @@ def main(argv=None):
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
     t0 = time.time()
-    for i in range(args.warmup):
+    for i in range(max(1, args.warmup)):
         params, opt_state, loss = step(params, opt_state, batch,
                                        jax.random.fold_in(rng, i))
     jax.block_until_ready(loss)

@@ -1,0 +1,15 @@
+{{/*
+Run identity: release name + render-time timestamp — preserves the
+reference's release-timestamping contract (charts/maskrcnn/templates/
+maskrcnn.yaml:50-51 and tensorboard.yaml:48-49) that ties the training
+job, TensorBoard and the notebooks to one run directory.  Helm 3 has no
+.Release.Time, so `now` is pinned once via a chart-scoped cache.
+*/}}
+{{- define "maskrcnn.runid" -}}
+{{- $cache := .Release.Name -}}
+{{- printf "%s-%s" .Release.Name (now | date "2006-01-02-15-04-05") -}}
+{{- end -}}
+
+{{- define "maskrcnn.hosts" -}}
+{{- div .Values.maskrcnn.chips .Values.maskrcnn.chips_per_host | max 1 -}}
+{{- end -}}

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Registry build/push pipeline ≙ reference
+# container/build_tools/build_and_push.sh:1-63 (create-ECR-repo-if-
+# missing, dual registry login, build/tag/push, print URI) — targeting
+# Artifact Registry.  Works for both the training image (default) and
+# the viz image (IMAGE_KIND=viz).
+#
+# Usage: [REGION=us-central1] [IMAGE_KIND=train|viz] bash build_and_push.sh
+
+set -e
+cd "$(dirname "$0")"
+source ./set_env.sh
+
+REGION=${REGION:-us-central1}
+PROJECT=${PROJECT:-$(gcloud config get-value project 2>/dev/null)}
+REPO=${REPO:-eksml-tpu}
+IMAGE_KIND=${IMAGE_KIND:-train}
+REGISTRY="${REGION}-docker.pkg.dev/${PROJECT}/${REPO}"
+
+# create-repo-if-missing ≙ reference build_and_push.sh:36-41
+gcloud artifacts repositories describe "$REPO" \
+    --location "$REGION" >/dev/null 2>&1 || \
+  gcloud artifacts repositories create "$REPO" \
+    --repository-format=docker --location "$REGION"
+
+# registry login ≙ reference :47-48,54-55
+gcloud auth configure-docker "${REGION}-docker.pkg.dev" --quiet
+
+REPO_ROOT="$(cd ../.. && pwd)"
+if [ "$IMAGE_KIND" = "viz" ]; then
+  IMAGE="${REGISTRY}/${IMAGE_NAME}-viz:${IMAGE_TAG}"
+  docker build -t "$IMAGE" \
+    --build-arg BASE_IMAGE="${REGISTRY}/${IMAGE_NAME}:${IMAGE_TAG}" \
+    -f "$REPO_ROOT/container-viz/Dockerfile" "$REPO_ROOT"
+else
+  IMAGE="${REGISTRY}/${IMAGE_NAME}:${IMAGE_TAG}"
+  docker build -t "$IMAGE" -f "$REPO_ROOT/container/Dockerfile" "$REPO_ROOT"
+fi
+
+docker push "$IMAGE"
+echo "$IMAGE"

@@ -1,0 +1,5 @@
+#!/bin/bash
+# ≙ reference container/build_tools/set_env.sh:1-4 (image name + tag
+# fed to build_and_push).
+export IMAGE_NAME=${IMAGE_NAME:-eksml-tpu-train}
+export IMAGE_TAG=${IMAGE_TAG:-jax-tpu-v1}

@@ -233,7 +233,12 @@ def _define_defaults() -> None:
     _C.TPU.MESH_SHAPE = ()         # () → (num_devices, 1)
     _C.TPU.MESH_AXES = ("data", "model")
     _C.TPU.TOPOLOGY = ""           # e.g. "v5e-32"; validated like the CRD schema
+    # 0 = auto-size from model scale via the native shim
+    # (parallel/native.py recommend_combine_threshold)
     _C.TPU.ALLREDUCE_COMBINE_THRESHOLD_BYTES = 64 * 1024 * 1024
+    # ≙ §5.1: jax.profiler trace server port (0 = off); the NCCL_DEBUG
+    # analogue for perf visibility
+    _C.TPU.PROFILER_PORT = 0
     _C.TPU.COORDINATOR_ADDRESS = ""   # JobSet headless-service DNS
     _C.TPU.NUM_PROCESSES = 1
     _C.TPU.PROCESS_ID = 0

@@ -84,8 +84,15 @@ def rle_decode(rle: Dict, height: int = None, width: int = None) -> np.ndarray:
 
 
 def rle_encode(mask: np.ndarray) -> Dict:
-    """Encode binary [h, w] mask into uncompressed COCO RLE counts."""
+    """Encode binary [h, w] mask into uncompressed COCO RLE counts
+    (C++ fast path when built — the eval hot loop pastes + encodes one
+    mask per detection)."""
     h, w = mask.shape
+    from eksml_tpu.evalcoco.native import rle_encode_native
+
+    counts = rle_encode_native(mask)
+    if counts is not None:
+        return {"size": [h, w], "counts": counts}
     flat = np.asfortranarray(mask.astype(np.uint8)).T.reshape(-1)
     # run lengths alternating 0s then 1s
     diffs = np.nonzero(np.diff(flat))[0] + 1

@@ -37,15 +37,25 @@ def _try_build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """True when the source is newer than the built library."""
+    src = os.path.join(_SRC_DIR, "maskops.cc")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
-    """Load (building on first use if needed) the native library."""
+    """Load (building on first use / source change) the native library."""
     global _lib, _load_attempted
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True
-    if not os.path.exists(_LIB_PATH) and not _try_build():
-        log.info("native maskops unavailable; using numpy fallback")
-        return None
+    if (not os.path.exists(_LIB_PATH) or _stale()) and not _try_build():
+        if not os.path.exists(_LIB_PATH):
+            log.info("native maskops unavailable; using numpy fallback")
+            return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
         u8p = ctypes.POINTER(ctypes.c_uint8)

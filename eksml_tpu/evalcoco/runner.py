@@ -78,12 +78,18 @@ def make_predict_fn(model) -> Callable:
 def run_evaluation(model, params, cfg, records: List[Dict],
                    batch_size: int = 1,
                    max_images: Optional[int] = None,
-                   predict_fn: Optional[Callable] = None) -> Dict[str, float]:
+                   predict_fn: Optional[Callable] = None,
+                   gt_records: Optional[List[Dict]] = None
+                   ) -> Dict[str, float]:
     """Evaluate ``model(params)`` on COCO ``records``; returns AP dict.
 
     Every host predicts records[host_id::num_hosts]; fixed-shape
-    detection arrays are all-gathered and host 0's accumulate result is
-    returned on all hosts (harmless recompute elsewhere).
+    detection arrays are all-gathered and the COORDINATOR accumulates —
+    non-coordinator processes return an empty dict (only the
+    coordinator owns the MetricWriter, SURVEY.md §5.5).
+
+    ``gt_records``: pre-built evaluator GT (from :func:`build_gt_records`)
+    to reuse across periodic evals; rebuilt when None.
     """
     from eksml_tpu.evalcoco.cocoeval import COCOEvaluator
 
@@ -156,7 +162,8 @@ def run_evaluation(model, params, cfg, records: List[Dict],
     results: Dict[str, float] = {}
     if jax.process_index() == 0 or num_hosts == 1:
         by_id = {rec["image_id"]: rec for rec in records}
-        gt = build_gt_records(records, with_masks)
+        gt = (gt_records if gt_records is not None
+              else build_gt_records(records, with_masks))
         bbox_ev = COCOEvaluator(gt, cfg.DATA.NUM_CLASSES, "bbox",
                                 max_dets=cfg.TEST.RESULTS_PER_IM)
         segm_ev = (COCOEvaluator(gt, cfg.DATA.NUM_CLASSES, "segm",
@@ -201,9 +208,14 @@ def make_eval_fn(cfg) -> Callable:
         if "records" not in state:
             ds = CocoDataset(cfg.DATA.BASEDIR, cfg.DATA.VAL)
             state["records"] = ds.records(skip_empty=False)
+            # GT rasterization/RLE is identical every eval — build once
+            if jax.process_index() == 0:
+                state["gt"] = build_gt_records(state["records"],
+                                               bool(cfg.MODE_MASK))
         return run_evaluation(
             model, params, cfg, state["records"],
             predict_fn=state.setdefault("predict_fn",
-                                        make_predict_fn(model)))
+                                        make_predict_fn(model)),
+            gt_records=state.get("gt"))
 
     return eval_fn

@@ -1,0 +1,117 @@
+"""ctypes bridge to the native comm-layer shim (topology.cc).
+
+The reference's comm layer was native (NCCL ring construction, Horovod
+fusion buffering — SURVEY.md §5.8); here the compiled surface owns
+slice geometry, DCN ring ordering and combine-threshold sizing, with
+pure-python fallbacks so nothing requires the build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_topology.so")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "native_src")
+_lib = None
+_load_attempted = False
+
+
+def _stale() -> bool:
+    src = os.path.join(_SRC_DIR, "topology.cc")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not os.path.exists(_LIB_PATH) or _stale():
+        try:
+            subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception as e:
+            log.debug("topology shim build failed: %s", e)
+        if not os.path.exists(_LIB_PATH):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.topo_lookup.argtypes = [ctypes.c_char_p, i32p, i32p, i32p, i32p]
+        lib.topo_lookup.restype = ctypes.c_int32
+        lib.topo_validate.argtypes = [ctypes.c_int32, ctypes.c_int32]
+        lib.topo_validate.restype = ctypes.c_int32
+        lib.topo_chip_coords.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                         i32p, i32p]
+        lib.topo_chip_coords.restype = ctypes.c_int32
+        lib.topo_host_ring.argtypes = [ctypes.c_char_p, i32p]
+        lib.topo_host_ring.restype = ctypes.c_int32
+        lib.combine_threshold_bytes.argtypes = [ctypes.c_int64,
+                                                ctypes.c_int32]
+        lib.combine_threshold_bytes.restype = ctypes.c_int64
+        _lib = lib
+    except OSError as e:
+        log.warning("failed to load %s: %s", _LIB_PATH, e)
+    return _lib
+
+
+def topo_lookup(name: str) -> Optional[Tuple[int, int, int, int]]:
+    """(chips, hosts, mesh_x, mesh_y) for a slice name, native path."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = [ctypes.c_int32() for _ in range(4)]
+    rc = lib.topo_lookup(name.encode(), *[ctypes.byref(v) for v in vals])
+    if rc != 0:
+        return None
+    return tuple(v.value for v in vals)
+
+
+def host_ring(name: str) -> Optional[List[int]]:
+    """Snake-order host ring for minimum-hop DCN collectives."""
+    lib = get_lib()
+    if lib is None:
+        return _host_ring_py(name)
+    info = topo_lookup(name)
+    if info is None:
+        return None
+    hosts = info[1]
+    buf = (ctypes.c_int32 * hosts)()
+    n = lib.topo_host_ring(name.encode(), buf)
+    if n <= 0:
+        return None
+    return list(buf[:n])
+
+
+def _host_ring_py(name: str) -> Optional[List[int]]:
+    from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES
+
+    if name not in V5E_TOPOLOGIES:
+        return None
+    chips, hosts = V5E_TOPOLOGIES[name]
+    grid = {1: 1, 4: 2, 8: 2, 16: 4, 32: 4, 64: 8, 128: 8, 256: 16}
+    hx = max(grid.get(chips, 1) // 2, 1)
+    hy = max(hosts // hx, 1)
+    order = []
+    for row in range(hy):
+        cols = range(hx) if row % 2 == 0 else range(hx - 1, -1, -1)
+        order += [row * hx + c for c in cols]
+    return order
+
+
+def recommend_combine_threshold(param_bytes: int, chips: int) -> int:
+    """HOROVOD_FUSION_THRESHOLD analogue, sized from model scale."""
+    lib = get_lib()
+    if lib is not None:
+        return int(lib.combine_threshold_bytes(param_bytes, chips))
+    t = max(4 << 20, min(param_bytes // 8, 64 << 20))
+    return t // 2 if chips > 256 else t

@@ -132,9 +132,22 @@ class Trainer:
         self.logdir = logdir
         self.eval_fn = eval_fn
 
-        if cfg.TPU.ALLREDUCE_COMBINE_THRESHOLD_BYTES:
-            set_xla_collective_flags(
-                cfg.TPU.ALLREDUCE_COMBINE_THRESHOLD_BYTES)
+        threshold = cfg.TPU.ALLREDUCE_COMBINE_THRESHOLD_BYTES
+        if threshold == 0:
+            # auto-size from model scale (R50-FPN Mask-RCNN ≈ 180 MB of
+            # f32 params) — the native shim's HOROVOD_FUSION analogue
+            from eksml_tpu.parallel.native import \
+                recommend_combine_threshold
+
+            threshold = recommend_combine_threshold(
+                180 * 1024 * 1024, max(1, cfg.TRAIN.NUM_CHIPS))
+        if threshold:
+            set_xla_collective_flags(threshold)
+        if cfg.TPU.PROFILER_PORT and jax.process_index() == 0:
+            # perf visibility (SURVEY.md §5.1): trace server for
+            # `jax.profiler`/TensorBoard profile plugin — the
+            # NCCL_DEBUG=INFO analogue
+            jax.profiler.start_server(cfg.TPU.PROFILER_PORT)
         validate_topology(cfg.TPU.TOPOLOGY or "",
                           num_chips=(cfg.TRAIN.NUM_CHIPS
                                      if cfg.TRAIN.NUM_CHIPS > 1 else None),
@@ -310,6 +323,11 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # explicit platform pin (e.g. EKSML_PLATFORM=cpu for the run.sh
+    # smoke on a host whose site config pre-selects an accelerator)
+    platform = os.environ.get("EKSML_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
     args = parse_args(argv)
 
     cfg = config_from_env(global_config)

@@ -1,0 +1,7 @@
+#!/bin/bash
+# ≙ reference eks-cluster/apply-nvidia-plugin.sh:1-4.  GKE TPU
+# nodepools ship the device plugin, so only the verification half
+# remains: print per-node TPU allocatable (the "node/GPU sanity" rung
+# of the verification ladder, SURVEY.md §4).
+kubectl get nodes \
+  "-o=custom-columns=NAME:.metadata.name,TPU:.status.allocatable.google\.com/tpu"

@@ -1,0 +1,11 @@
+#!/bin/bash
+# ≙ reference eks-cluster/install-kubectl-linux.sh:1-15, which pinned
+# kubectl + aws-iam-authenticator binaries.  GKE auth rides gcloud, so
+# only kubectl (+ the gke auth plugin) is installed.
+set -e
+KUBECTL_VERSION=${KUBECTL_VERSION:-v1.31.0}
+curl -fsSLo /usr/local/bin/kubectl \
+  "https://dl.k8s.io/release/${KUBECTL_VERSION}/bin/linux/amd64/kubectl"
+chmod +x /usr/local/bin/kubectl
+gcloud components install gke-gcloud-auth-plugin --quiet || true
+kubectl version --client

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Host-side bucket → mounted-filesystem prep ≙ reference
+# eks-cluster/prepare-data.sh:1-31: pull the dataset from the bucket
+# onto an already-mounted shared filesystem and drop run.sh next to it
+# (reference :28-31) so a JobSet command of `bash /efs/run.sh` works.
+set -e
+GCS_BUCKET=${GCS_BUCKET:?set GCS_BUCKET}
+GCS_PREFIX=${GCS_PREFIX:-eksml-tpu/data}
+MOUNT=${MOUNT:-/efs}
+
+mkdir -p "$MOUNT/data"
+gsutil -m rsync -r "gs://$GCS_BUCKET/$GCS_PREFIX" "$MOUNT/data"
+cp "$(dirname "$0")/../../run.sh" "$MOUNT/run.sh"
+echo "data + run.sh staged under $MOUNT"

@@ -1,0 +1,29 @@
+#!/bin/bash
+# COCO-2017 → object store stager ≙ reference
+# eks-cluster/prepare-s3-bucket.sh:1-36: download train/val images,
+# annotations and the ImageNet-R50 backbone to a build host, upload to
+# the bucket the stage-data Pod later copies onto the shared filesystem.
+#
+# Usage: GCS_BUCKET=my-bucket bash prepare-gcs-bucket.sh
+
+set -e
+GCS_BUCKET=${GCS_BUCKET:?set GCS_BUCKET}
+STAGE_DIR=${STAGE_DIR:-$HOME/stage/eksml-tpu}
+
+mkdir -p "$STAGE_DIR/data" && cd "$STAGE_DIR/data"
+
+# same artifacts the reference pulls (prepare-s3-bucket.sh:21-34)
+wget -nc http://images.cocodataset.org/zips/train2017.zip
+wget -nc http://images.cocodataset.org/zips/val2017.zip
+wget -nc http://images.cocodataset.org/zips/test2017.zip
+wget -nc http://images.cocodataset.org/annotations/annotations_trainval2017.zip
+for z in train2017 val2017 test2017 annotations_trainval2017; do
+  unzip -n $z.zip
+done
+
+mkdir -p pretrained-models && cd pretrained-models
+wget -nc http://models.tensorpack.com/FasterRCNN/ImageNet-R50-AlignPadding.npz
+cd ..
+
+gsutil -m rsync -r "$STAGE_DIR/data" "gs://$GCS_BUCKET/eksml-tpu/data"
+echo "staged to gs://$GCS_BUCKET/eksml-tpu/data"

@@ -1,0 +1,6 @@
+#!/bin/bash
+# ≙ reference eks-cluster/set-cluster.sh:1-4: name the target cluster
+# for the scripts below.
+export CLUSTER=${CLUSTER:-eksml-tpu}
+export ZONE=${ZONE:-us-central1-a}
+export PROJECT=${PROJECT:-$(gcloud config get-value project 2>/dev/null)}

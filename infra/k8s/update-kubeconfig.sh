@@ -1,0 +1,7 @@
+#!/bin/bash
+# ≙ reference eks-cluster/update-kubeconfig.sh:1-7 (`aws eks
+# update-kubeconfig`): merge credentials for $CLUSTER into kubeconfig.
+set -e
+source "$(dirname "$0")/set-cluster.sh"
+gcloud container clusters get-credentials "$CLUSTER" \
+  --zone "$ZONE" --project "$PROJECT"

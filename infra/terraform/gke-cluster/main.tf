@@ -1,0 +1,113 @@
+# Cluster-only provisioner ≙ reference
+# eks-cluster/terraform/aws-eks-cluster/aws-eks-cluster.tf:1-256 (VPC +
+# control plane + shared filesystem, no accelerator nodes): bring the
+# cluster up first, add/resize TPU slices later with ../tpu-nodepool.
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  region  = var.region
+}
+
+resource "google_compute_network" "vpc" {
+  name                    = "${var.cluster_name}-net"
+  auto_create_subnetworks = false
+}
+
+resource "google_compute_subnetwork" "subnet" {
+  name                     = "${var.cluster_name}-subnet"
+  network                  = google_compute_network.vpc.id
+  region                   = var.region
+  ip_cidr_range            = var.subnet_cidr
+  private_ip_google_access = true
+}
+
+resource "google_compute_firewall" "intra" {
+  name    = "${var.cluster_name}-intra"
+  network = google_compute_network.vpc.name
+  allow {
+    protocol = "tcp"
+  }
+  allow {
+    protocol = "udp"
+  }
+  source_ranges = [var.subnet_cidr]
+}
+
+resource "google_filestore_instance" "shared" {
+  name     = "${var.cluster_name}-shared"
+  location = var.zone
+  tier     = var.filestore_tier
+
+  file_shares {
+    capacity_gb = var.filestore_capacity_gb
+    name        = "shared"
+  }
+
+  networks {
+    network = google_compute_network.vpc.name
+    modes   = ["MODE_IPV4"]
+  }
+}
+
+resource "google_container_cluster" "cluster" {
+  name                     = var.cluster_name
+  location                 = var.zone
+  network                  = google_compute_network.vpc.id
+  subnetwork               = google_compute_subnetwork.subnet.id
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  release_channel {
+    channel = var.release_channel
+  }
+
+  # kubeconfig emission ≙ reference aws-eks-cluster.tf:205-238 output
+  provisioner "local-exec" {
+    command = "gcloud container clusters get-credentials ${var.cluster_name} --zone ${var.zone} --project ${var.project}"
+  }
+}
+
+variable "project" { type = string }
+variable "region" {
+  type    = string
+  default = "us-central1"
+}
+variable "zone" {
+  type    = string
+  default = "us-central1-a"
+}
+variable "cluster_name" {
+  type    = string
+  default = "eksml-tpu"
+}
+variable "subnet_cidr" {
+  type    = string
+  default = "10.10.0.0/16"
+}
+variable "release_channel" {
+  type    = string
+  default = "REGULAR"
+}
+variable "filestore_tier" {
+  type    = string
+  default = "BASIC_HDD"
+}
+variable "filestore_capacity_gb" {
+  type    = number
+  default = 2560
+}
+
+output "network" { value = google_compute_network.vpc.name }
+output "cluster" { value = google_container_cluster.cluster.name }
+output "filestore_ip" {
+  value = google_filestore_instance.shared.networks[0].ip_addresses[0]
+}

@@ -1,0 +1,142 @@
+# Combined cluster + TPU nodepool provisioner — the TPU-native
+# re-expression of the reference's one-shot
+# eks-cluster/terraform/aws-eks-cluster-and-nodegroup/
+# aws-eks-cluster-and-nodegroup.tf:1-499 (VPC + EKS control plane + EFS
+# + GPU autoscaling group + NVIDIA device plugin).  Structural map:
+#   VPC/subnets/IGW (:140-191)        → google_compute_network/subnetwork
+#   EKS control plane (:261-285)      → google_container_cluster
+#   GPU ASG from EKS-GPU AMI (:389-455) → google_container_node_pool with
+#       a TPU v5e podslice placement (no AMI catalog needed — the TPU
+#       machine type + topology IS the "AMI")
+#   EFS + mount targets (:250-259,457-463) → google_filestore_instance
+#   apply-nvidia-plugin local-exec (:465-477) → nothing: GKE TPU
+#       nodepools ship the TPU device plugin; kubeconfig via local-exec
+#       `gcloud container clusters get-credentials` (≙ :276-278)
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  region  = var.region
+}
+
+# ---- network (≙ aws_vpc + subnets + igw, reference :140-191) --------
+
+resource "google_compute_network" "vpc" {
+  name                    = "${var.cluster_name}-net"
+  auto_create_subnetworks = false
+}
+
+resource "google_compute_subnetwork" "subnet" {
+  name          = "${var.cluster_name}-subnet"
+  network       = google_compute_network.vpc.id
+  region        = var.region
+  ip_cidr_range = var.subnet_cidr
+  private_ip_google_access = true
+}
+
+# intra-cluster traffic wide open, as the reference SGs
+# (:223-248, 334-379) — collectives ride ICI, but host-level DCN and
+# the jax.distributed coordinator need node-to-node TCP
+resource "google_compute_firewall" "intra" {
+  name    = "${var.cluster_name}-intra"
+  network = google_compute_network.vpc.name
+  allow {
+    protocol = "tcp"
+  }
+  allow {
+    protocol = "udp"
+  }
+  source_ranges = [var.subnet_cidr]
+}
+
+# ---- shared RWX filesystem (≙ aws_efs_file_system :250-259) ---------
+
+resource "google_filestore_instance" "shared" {
+  name     = "${var.cluster_name}-shared"
+  location = var.zone
+  tier     = var.filestore_tier
+
+  file_shares {
+    capacity_gb = var.filestore_capacity_gb
+    name        = "shared"
+  }
+
+  networks {
+    network = google_compute_network.vpc.name
+    modes   = ["MODE_IPV4"]
+  }
+}
+
+# ---- control plane (≙ aws_eks_cluster :261-285) ---------------------
+
+resource "google_container_cluster" "cluster" {
+  name     = var.cluster_name
+  location = var.zone
+
+  network    = google_compute_network.vpc.id
+  subnetwork = google_compute_subnetwork.subnet.id
+
+  # nodepools managed separately, as the reference splits cluster and
+  # nodegroup provisioners (§2a #2/#3)
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  release_channel {
+    channel = var.release_channel
+  }
+
+  # kubeconfig merge, ≙ local-exec aws eks update-kubeconfig (:276-278)
+  provisioner "local-exec" {
+    command = "gcloud container clusters get-credentials ${var.cluster_name} --zone ${var.zone} --project ${var.project}"
+  }
+}
+
+# ---- CPU system pool (runs operators/TensorBoard, not training) -----
+
+resource "google_container_node_pool" "system" {
+  name       = "system"
+  cluster    = google_container_cluster.cluster.id
+  node_count = var.system_node_count
+
+  node_config {
+    machine_type = var.system_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+# ---- TPU v5e slice nodepool (≙ GPU launch config + ASG :389-455) ----
+# One nodepool node = one v5e host (4 chips).  The slice topology
+# determines node count: v5e-32 = 8 hosts in one 8x4 podslice.
+
+resource "google_container_node_pool" "tpu" {
+  name    = "tpu-${replace(var.tpu_topology, "x", "-")}"
+  cluster = google_container_cluster.cluster.id
+
+  # ≙ ASG desired/max/min (:86-102, 437-440); TPU podslices scale as a
+  # unit so initial == node count for the topology
+  node_count = var.tpu_hosts
+
+  node_config {
+    machine_type = var.tpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+
+    # replaces the AMI catalog + bootstrap.sh user-data (:104-122,
+    # 381-387): GKE selects the TPU image from the accelerator config
+    labels = {
+      role = "training"
+    }
+  }
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+}

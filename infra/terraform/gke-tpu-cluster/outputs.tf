@@ -1,0 +1,54 @@
+# ≙ the reference's human-readable summary output
+# (aws-eks-cluster-and-nodegroup.tf:479-499) and the rendered PV/PVC
+# manifests emitted by aws-eks-nodegroup.tf:273-348 — here the PV is
+# rendered from the Filestore IP for kubectl apply.
+
+output "summary" {
+  value = <<-EOT
+    cluster:    ${google_container_cluster.cluster.name} (${var.zone})
+    network:    ${google_compute_network.vpc.name} / ${google_compute_subnetwork.subnet.ip_cidr_range}
+    tpu pool:   ${var.tpu_hosts} × ${var.tpu_machine_type} (topology ${var.tpu_topology})
+    filestore:  ${google_filestore_instance.shared.networks[0].ip_addresses[0]}:/shared
+  EOT
+}
+
+output "filestore_ip" {
+  value = google_filestore_instance.shared.networks[0].ip_addresses[0]
+}
+
+# rendered RWX PV/PVC (≙ aws-eks-nodegroup.tf:273-348 emitting
+# EFS PV/PVC); apply with: terraform output -raw shared_fs_manifests | kubectl apply -f -
+output "shared_fs_manifests" {
+  value = <<-EOT
+    apiVersion: v1
+    kind: PersistentVolume
+    metadata:
+      name: eksml-shared-fs
+    spec:
+      capacity:
+        storage: ${var.filestore_capacity_gb}Gi
+      accessModes:
+        - ReadWriteMany
+      nfs:
+        server: ${google_filestore_instance.shared.networks[0].ip_addresses[0]}
+        path: /shared
+      mountOptions:
+        - nfsvers=3
+        - rsize=1048576
+        - wsize=1048576
+    ---
+    apiVersion: v1
+    kind: PersistentVolumeClaim
+    metadata:
+      name: eksml-shared-fs
+      namespace: kubeflow
+    spec:
+      accessModes:
+        - ReadWriteMany
+      storageClassName: ""
+      volumeName: eksml-shared-fs
+      resources:
+        requests:
+          storage: ${var.filestore_capacity_gb}Gi
+  EOT
+}

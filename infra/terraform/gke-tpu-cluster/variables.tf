@@ -1,0 +1,79 @@
+# Variable surface ≙ the reference's
+# aws-eks-cluster-and-nodegroup.tf:1-130: cluster_name, region/azs →
+# region/zone, k8s_version → release_channel, node_instance_type
+# (default p3.16xlarge, :75-79) → tpu_machine_type + tpu_topology,
+# node_group_desired/max/min → tpu_hosts.
+
+variable "project" {
+  description = "GCP project id"
+  type        = string
+}
+
+variable "region" {
+  description = "Region (≙ reference var.region)"
+  type        = string
+  default     = "us-central1"
+}
+
+variable "zone" {
+  description = "Zone hosting the TPU slice (≙ reference var.azs[0])"
+  type        = string
+  default     = "us-central1-a"
+}
+
+variable "cluster_name" {
+  description = "Cluster name (≙ reference var.cluster_name)"
+  type        = string
+  default     = "eksml-tpu"
+}
+
+variable "subnet_cidr" {
+  type    = string
+  default = "10.10.0.0/16"
+}
+
+variable "release_channel" {
+  description = "GKE channel (≙ reference var.k8s_version pinning)"
+  type        = string
+  default     = "REGULAR"
+}
+
+# ≙ node_instance_type default p3.16xlarge (8×V100); ct5lp-hightpu-4t is
+# the v5e host machine (4 chips)
+variable "tpu_machine_type" {
+  type    = string
+  default = "ct5lp-hightpu-4t"
+}
+
+# slice topology; v5e-32 north star = 8x4
+variable "tpu_topology" {
+  type    = string
+  default = "8x4"
+}
+
+# hosts in the slice = chips / 4 (≙ node_group_desired, :86-90)
+variable "tpu_hosts" {
+  type    = number
+  default = 8
+}
+
+variable "system_machine_type" {
+  type    = string
+  default = "e2-standard-8"
+}
+
+variable "system_node_count" {
+  type    = number
+  default = 2
+}
+
+variable "filestore_tier" {
+  description = "Filestore tier (≙ EFS generalPurpose/bursting)"
+  type        = string
+  default     = "BASIC_HDD"
+}
+
+variable "filestore_capacity_gb" {
+  type    = number
+  default = 2560
+}

@@ -1,0 +1,76 @@
+# Nodepool-only provisioner ≙ reference
+# eks-cluster/terraform/aws-eks-nodegroup/aws-eks-nodegroup.tf:1-364:
+# attach a TPU slice to an EXISTING cluster (discovered by name, ≙ the
+# `data aws_eks_cluster` lookup at :114-116).  No AMI catalog (≙
+# :80-98) is needed — the machine type + topology select the image; no
+# aws-auth ConfigMap (≙ :273-299) — GKE nodes join via IAM.
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  region  = var.region
+}
+
+data "google_container_cluster" "existing" {
+  name     = var.cluster_name
+  location = var.zone
+}
+
+resource "google_container_node_pool" "tpu" {
+  name       = var.pool_name
+  cluster    = data.google_container_cluster.existing.id
+  node_count = var.tpu_hosts
+
+  node_config {
+    machine_type = var.tpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+    labels = {
+      role = "training"
+    }
+  }
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+}
+
+variable "project" { type = string }
+variable "region" {
+  type    = string
+  default = "us-central1"
+}
+variable "zone" {
+  type    = string
+  default = "us-central1-a"
+}
+variable "cluster_name" {
+  type    = string
+  default = "eksml-tpu"
+}
+variable "pool_name" {
+  type    = string
+  default = "tpu-v5e"
+}
+variable "tpu_machine_type" {
+  type    = string
+  default = "ct5lp-hightpu-4t"
+}
+variable "tpu_topology" {
+  type    = string
+  default = "8x4"
+}
+variable "tpu_hosts" {
+  type    = number
+  default = 8
+}
+
+output "nodepool" { value = google_container_node_pool.tpu.name }

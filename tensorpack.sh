@@ -1,0 +1,38 @@
+#!/bin/bash
+# Manual multi-host launcher — entrypoint name preserved from the
+# reference's tensorpack.sh (legacy ksonnet/kubeflow-openmpi path,
+# reference tensorpack.sh:1-63).  The ksonnet machinery (ks init /
+# registry / pkg install openmpi, :19-29) and the ssh-keypair Secret the
+# MPI world needed (:10-14) have no TPU equivalent: rendezvous is
+# jax.distributed over a stable headless-service DNS, so this script
+# reduces to namespace setup + a JobSet apply rendered from the chart.
+#
+# Usage: EKSML_IMAGE=<image> NUM_HOSTS=2 bash tensorpack.sh
+
+set -e
+
+NAMESPACE=${NAMESPACE:-kubeflow}
+APP_NAME=${APP_NAME:-tensorpack}
+NUM_HOSTS=${NUM_HOSTS:-1}
+CHIPS_PER_HOST=${CHIPS_PER_HOST:-4}
+EKSML_IMAGE=${EKSML_IMAGE:?set EKSML_IMAGE to the training image}
+SHARED_PVC=${SHARED_PVC:-eksml-shared-fs}
+EXEC=${EXEC:-"bash /efs/run.sh"}
+
+# namespace, as reference tensorpack.sh:6-7
+kubectl get namespace $NAMESPACE >/dev/null 2>&1 || \
+  kubectl create namespace $NAMESPACE
+
+# no ssh Secret needed (reference :10-14): JobSet pods rendezvous via
+# DNS + jax.distributed.initialize; render the chart and apply
+helm template $APP_NAME ./charts/maskrcnn \
+  --namespace $NAMESPACE \
+  --set global.shared_pvc=$SHARED_PVC \
+  --set maskrcnn.image=$EKSML_IMAGE \
+  --set maskrcnn.chips=$(( NUM_HOSTS * CHIPS_PER_HOST )) \
+  --set maskrcnn.chips_per_host=$CHIPS_PER_HOST \
+  --set maskrcnn.command="$EXEC" \
+  | kubectl apply -n $NAMESPACE -f -
+
+echo "launched JobSet '$APP_NAME' ($NUM_HOSTS hosts x $CHIPS_PER_HOST chips)"
+echo "follow logs:  kubectl logs -f -n $NAMESPACE -l jobset.sigs.k8s.io/jobset-name=$APP_NAME"

@@ -1,0 +1,73 @@
+"""Native comm-shim tests: the C++ topology/collective-config layer
+(parallel/native_src/topology.cc) must agree with the python inventory
+(parallel/mesh.py) — the same dual-source risk the reference carried
+between its CRD schema and the operator's --gpus-per-node arithmetic.
+"""
+
+import numpy as np
+import pytest
+
+from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES, validate_topology
+from eksml_tpu.parallel.native import (get_lib, host_ring,
+                                       recommend_combine_threshold,
+                                       topo_lookup)
+
+
+def test_native_lib_builds():
+    assert get_lib() is not None, "C++ topology shim failed to build"
+
+
+@pytest.mark.parametrize("name", sorted(V5E_TOPOLOGIES))
+def test_lookup_agrees_with_python_inventory(name):
+    info = topo_lookup(name)
+    assert info is not None
+    chips, hosts, mx, my = info
+    assert (chips, hosts) == V5E_TOPOLOGIES[name]
+    assert mx * my == chips  # physical grid covers the slice
+
+
+def test_lookup_unknown():
+    assert topo_lookup("v5e-7") is None
+
+
+@pytest.mark.parametrize("name", sorted(V5E_TOPOLOGIES))
+def test_host_ring_is_permutation(name):
+    _, hosts = V5E_TOPOLOGIES[name]
+    ring = host_ring(name)
+    assert sorted(ring) == list(range(hosts))
+
+
+def test_host_ring_snake_adjacency():
+    # v5e-32: 8 hosts on a 2x4 grid; snake order keeps consecutive ring
+    # members adjacent (|Δrow| + |Δcol| == 1), the minimum-hop property
+    ring = host_ring("v5e-32")
+    hx = 2
+    coords = [(h // hx, h % hx) for h in ring]
+    for a, b in zip(coords, coords[1:]):
+        assert abs(a[0] - b[0]) + abs(a[1] - b[1]) == 1, (a, b)
+
+
+def test_native_validate_matches_python():
+    lib = get_lib()
+    for chips in (1, 2, 4, 8, 32, 256):
+        hosts = lib.topo_validate(chips, 4)
+        assert hosts == validate_topology(num_chips=chips)[1]
+    for chips in (0, 3, 6, -4):
+        assert lib.topo_validate(chips, 4) == -1
+        if chips > 0:
+            with pytest.raises(ValueError):
+                validate_topology(num_chips=chips)
+
+
+def test_combine_threshold_bounds():
+    mb = 1024 * 1024
+    # small model → floor
+    assert recommend_combine_threshold(1 * mb, 32) == 4 * mb
+    # R50-scale (180 MB) → ~22 MB, inside [4, 64] MB
+    t = recommend_combine_threshold(180 * mb, 32)
+    assert 4 * mb <= t <= 64 * mb
+    # huge model → ceiling
+    assert recommend_combine_threshold(10_000 * mb, 32) == 64 * mb
+    # DCN-spanning slices halve it
+    assert (recommend_combine_threshold(10_000 * mb, 512)
+            == 32 * mb)

@@ -1,0 +1,129 @@
+"""Orchestration-artifact consistency checks.
+
+No helm/terraform binaries exist in the test environment, so these
+validate what can be validated statically: YAML manifests parse, chart
+values files carry the keys the templates reference, the template pair
+stays in sync across the two chart variants (the reference keeps
+byte-identical copies, SURVEY.md §2a note), and the entrypoint scripts
+keep their contracts.
+"""
+
+import os
+import re
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+# ---- plain-YAML manifests (no templating) ---------------------------
+
+K8S_MANIFESTS = [
+    "infra/k8s/pv-filestore.yaml",
+    "infra/k8s/pvc-filestore.yaml",
+    "infra/k8s/gcs-sc.yaml",
+    "infra/k8s/stage-data.yaml",
+    "infra/k8s/replicate-data.yaml",
+    "infra/k8s/attach-pvc.yaml",
+]
+
+
+@pytest.mark.parametrize("rel", K8S_MANIFESTS)
+def test_k8s_manifest_parses(rel):
+    docs = [d for d in yaml.safe_load_all(_read(rel)) if d]
+    assert docs, rel
+    for d in docs:
+        assert "kind" in d and "apiVersion" in d, rel
+
+
+def test_shared_pvc_name_is_consistent():
+    """The PVC name is the cross-layer contract (≙ the reference's
+    tensorpack-efs-gp-bursting, charts/maskrcnn/values.yaml:4)."""
+    pvc = yaml.safe_load(_read("infra/k8s/pvc-filestore.yaml"))
+    name = pvc["metadata"]["name"]
+    for chart in ("charts/maskrcnn/values.yaml",
+                  "charts/maskrcnn-optimized/values.yaml"):
+        vals = yaml.safe_load(_read(chart))
+        assert vals["global"]["shared_pvc"] == name, chart
+    for manifest in ("infra/k8s/stage-data.yaml",
+                     "infra/k8s/attach-pvc.yaml"):
+        assert name in _read(manifest), manifest
+
+
+# ---- chart values vs template references ----------------------------
+
+def _template_value_keys(text):
+    """All .Values.x.y paths a template references."""
+    return set(re.findall(r"\.Values\.([A-Za-z0-9_.]+)", text))
+
+
+@pytest.mark.parametrize("chart", ["charts/maskrcnn",
+                                   "charts/maskrcnn-optimized"])
+def test_chart_template_keys_exist_in_values(chart):
+    vals = yaml.safe_load(_read(f"{chart}/values.yaml"))
+    text = _read(f"{chart}/templates/maskrcnn.yaml") + \
+        _read(f"{chart}/templates/_helpers.tpl")
+    for key in _template_value_keys(text):
+        node = vals
+        for part in key.split("."):
+            assert isinstance(node, dict) and part in node, (
+                f"{chart}: template references .Values.{key} missing "
+                f"from values.yaml")
+            node = node[part]
+
+
+def test_chart_variants_share_template():
+    """The optimized chart differs only in values (reference keeps
+    byte-identical template copies, SURVEY.md §2a)."""
+    assert _read("charts/maskrcnn/templates/maskrcnn.yaml") == \
+        _read("charts/maskrcnn-optimized/templates/maskrcnn.yaml")
+
+
+def test_optimized_values_match_reference_deltas():
+    vals = yaml.safe_load(
+        _read("charts/maskrcnn-optimized/values.yaml"))["maskrcnn"]
+    assert vals["precision"] == "bfloat16"      # ≙ TENSORPACK_FP16
+    assert vals["batch_size_per_chip"] == 4     # ≙ BATCH_SIZE_PER_GPU=4
+    assert "(16,0.1)" in vals["lr_epoch_schedule"].replace(" ", "")
+    assert "TRAIN.GRADIENT_CLIP=0.36" in vals["extra_config"]
+
+
+def test_jobset_chart_topologies_match_runtime_inventory():
+    from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES
+
+    vals = yaml.safe_load(_read("charts/jobset/values.yaml"))
+    assert set(vals["topologies"]) == set(V5E_TOPOLOGIES)
+
+
+# ---- entrypoint scripts ---------------------------------------------
+
+def test_run_sh_contract():
+    text = _read("run.sh")
+    # epoch coupling and argv shape preserved (reference run.sh:15,33-45)
+    assert "120000 / NUM_PARALLEL" in text
+    assert "eksml_tpu.train" in text
+    assert "MODE_MASK" in text and "BACKBONE.NORM" in text
+    # SPMD: no process launcher actually invoked (comments may cite it)
+    assert not re.search(r"^\s*mpirun", text, re.M)
+
+
+def test_tensorpack_sh_contract():
+    text = _read("tensorpack.sh")
+    assert "helm template" in text and "kubectl apply" in text
+    assert "ssh-keygen" not in text  # no MPI ssh secret in JobSet world
+
+
+def test_graft_entry_surface():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(REPO, "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert callable(mod.entry) and callable(mod.dryrun_multichip)
