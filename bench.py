@@ -31,10 +31,16 @@ V100_IMAGES_PER_SEC = 20.0
 
 def main(argv=None):
     p = argparse.ArgumentParser(description="eksml_tpu throughput bench")
+    def positive_int(s):
+        v = int(s)
+        if v < 1:
+            raise argparse.ArgumentTypeError(
+                "must be >= 1 (the first call compiles and must stay "
+                "out of timing)")
+        return v
+
     p.add_argument("--steps", type=int, default=20)
-    # at least 1: the first call compiles and must stay out of timing
-    p.add_argument("--warmup", type=int, default=3,
-                   choices=None, metavar="N")
+    p.add_argument("--warmup", type=positive_int, default=3)
     p.add_argument("--batch-size", type=int, default=4)
     p.add_argument("--image-size", type=int, default=1024)
     p.add_argument("--precision", default="bfloat16",
@@ -93,7 +99,7 @@ def main(argv=None):
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
     t0 = time.time()
-    for i in range(max(1, args.warmup)):
+    for i in range(args.warmup):
         params, opt_state, loss = step(params, opt_state, batch,
                                        jax.random.fold_in(rng, i))
     jax.block_until_ready(loss)

@@ -1,0 +1,4 @@
+#!/bin/bash
+# ≙ reference container-optimized/build_tools/set_env.sh:1-4
+export IMAGE_NAME=${IMAGE_NAME:-eksml-tpu-train-optimized}
+export IMAGE_TAG=${IMAGE_TAG:-jax-tpu-v1}

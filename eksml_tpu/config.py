@@ -225,6 +225,10 @@ def _define_defaults() -> None:
     _C.TRAIN.EVAL_PERIOD = 1       # epochs (values.yaml:16)
     _C.TRAIN.CHECKPOINT_PERIOD = 2 # epochs (values.yaml:29 extra_config)
     _C.TRAIN.LOG_PERIOD = 20       # steps between metric writes
+    # debug mode (SURVEY.md §5.2): every N steps assert all data-parallel
+    # replicas hold identical params — the silent-divergence failure the
+    # reference's Horovod stack cannot detect.  0 = off.
+    _C.TRAIN.SYNC_CHECK_PERIOD = 0
     _C.TRAIN.SEED = 0
     _C.TRAIN.PRECISION = "float32" # "bfloat16" ≙ TENSORPACK_FP16/--fp16
     _C.TRAIN.LOGDIR = "/tmp/eksml_tpu/train_log/maskrcnn"

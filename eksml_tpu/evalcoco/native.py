@@ -56,6 +56,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_LIB_PATH):
             log.info("native maskops unavailable; using numpy fallback")
             return None
+        log.warning("maskops.cc changed but rebuild failed; NOT loading "
+                    "the stale %s — using numpy fallback", _LIB_PATH)
+        return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -73,7 +76,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                 ctypes.c_int64, u8p, f64p]
         lib.rle_iou.restype = None
         _lib = lib
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError: symbol mismatch (old binary / changed ABI)
         log.warning("failed to load %s: %s", _LIB_PATH, e)
     return _lib
 

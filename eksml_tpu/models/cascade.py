@@ -1,0 +1,88 @@
+"""Cascade R-CNN second stage: 3 box heads at increasing IoU quality.
+
+Parity target: TensorPack's ``CascadeRCNNHead`` (``modeling/
+model_cascade.py`` in the external repo pinned at reference
+container/Dockerfile:16-19), enabled by BASELINE.json configs[4]
+(Cascade Mask-RCNN R101-FPN).  Semantics follow the Cascade R-CNN
+paper as TensorPack implements it:
+
+- 3 stages with IoU thresholds CASCADE.IOUS = (0.5, 0.6, 0.7) and
+  per-stage box-encoding weights CASCADE.BBOX_REG_WEIGHTS;
+- class-agnostic box regression per stage (one delta set per ROI);
+- stage 1 trains on the sampled proposals; stages 2/3 train on the
+  previous stage's *refined* boxes, re-labeled at the stage's higher
+  IoU threshold — no re-sampling (the cascade's resampling effect
+  comes from refinement pushing boxes toward GT);
+- inference refines boxes stage-by-stage and averages the three
+  stages' class probabilities.
+
+TPU-first: every stage runs on the same static [S] ROI set; re-labeling
+is a masked IoU argmax, never a dynamic filter.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from eksml_tpu.models.rpn import smooth_l1
+from eksml_tpu.ops.boxes import (clip_boxes, decode_boxes, encode_boxes,
+                                 pairwise_iou)
+
+
+class CascadeBoxHead(nn.Module):
+    """2-FC head with per-class logits + class-agnostic deltas."""
+    num_classes: int = 81
+    fc_dim: int = 1024
+
+    @nn.compact
+    def __call__(self, roi_feats: jnp.ndarray):
+        x = roi_feats.reshape(roi_feats.shape[0], -1)
+        x = nn.relu(nn.Dense(self.fc_dim, name="fc6")(x))
+        x = nn.relu(nn.Dense(self.fc_dim, name="fc7")(x))
+        logits = nn.Dense(self.num_classes, name="class")(x)
+        deltas = nn.Dense(4, name="box")(x)
+        return logits, deltas
+
+
+def relabel_rois(rois: jnp.ndarray, gt_boxes: jnp.ndarray,
+                 gt_classes: jnp.ndarray, gt_valid: jnp.ndarray,
+                 gt_crowd: jnp.ndarray, iou_thresh: float
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Assign (labels, matched_gt, fg_mask) to a fixed ROI set at a
+    stage's IoU threshold — the cascade's per-stage re-labeling."""
+    target_ok = (gt_valid > 0) & (gt_crowd == 0)
+    iou = pairwise_iou(rois, gt_boxes) * target_ok[None, :].astype(
+        rois.dtype)
+    best = iou.max(axis=1)
+    matched = iou.argmax(axis=1)
+    fg = best >= iou_thresh
+    labels = jnp.where(fg, gt_classes[matched], 0)
+    return labels, matched, fg
+
+
+def refine_boxes(rois: jnp.ndarray, deltas: jnp.ndarray,
+                 reg_weights: Sequence[float], image_hw) -> jnp.ndarray:
+    """Class-agnostic decode + clip; gradients stopped (each stage
+    treats its input boxes as data, per the paper)."""
+    boxes = decode_boxes(deltas, rois, reg_weights)
+    boxes = clip_boxes(boxes, image_hw[0], image_hw[1])
+    return jax.lax.stop_gradient(boxes)
+
+
+def cascade_stage_losses(logits, deltas, rois, labels, matched_gt,
+                         gt_boxes, fg_mask, valid_mask, reg_weights):
+    """Per-stage CE + class-agnostic smooth-L1, TensorPack-normalized
+    (by sampled-proposal count)."""
+    n_valid = jnp.maximum(valid_mask.sum(), 1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    cls_loss = jnp.where(valid_mask, ce, 0.0).sum() / n_valid
+
+    targets = encode_boxes(gt_boxes[matched_gt], rois, reg_weights)
+    reg = smooth_l1(deltas - targets, beta=1.0).sum(-1)
+    box_loss = jnp.where(fg_mask & valid_mask, reg, 0.0).sum() / n_valid
+    return cls_loss, box_loss

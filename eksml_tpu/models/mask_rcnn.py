@@ -35,7 +35,7 @@ from eksml_tpu.models.rpn import (RPNHead, generate_proposals, match_anchors,
 from eksml_tpu.ops.anchors import generate_fpn_anchors
 from eksml_tpu.ops.boxes import clip_boxes, decode_boxes
 from eksml_tpu.ops.nms import class_aware_nms
-from eksml_tpu.ops.roi_align import (batched_multilevel_roi_align, roi_align)
+from eksml_tpu.ops.roi_align import dispatch_roi_align, roi_align
 
 
 class MaskRCNN(nn.Module):
@@ -69,6 +69,11 @@ class MaskRCNN(nn.Module):
     test_score_thresh: float = 0.05
     test_results_per_im: int = 100
     compute_dtype: Any = jnp.float32
+    # Cascade R-CNN (BASELINE configs[4]; models/cascade.py)
+    cascade: bool = False
+    cascade_ious: Tuple[float, ...] = (0.5, 0.6, 0.7)
+    cascade_reg_weights: Tuple[Tuple[float, ...], ...] = (
+        (10., 10., 5., 5.), (20., 20., 10., 10.), (30., 30., 15., 15.))
 
     @classmethod
     def from_config(cls, cfg) -> "MaskRCNN":
@@ -103,6 +108,10 @@ class MaskRCNN(nn.Module):
             test_results_per_im=cfg.TEST.RESULTS_PER_IM,
             compute_dtype=(jnp.bfloat16 if cfg.TRAIN.PRECISION == "bfloat16"
                            else jnp.float32),
+            cascade=cfg.MODE_CASCADE,
+            cascade_ious=tuple(cfg.CASCADE.IOUS),
+            cascade_reg_weights=tuple(
+                tuple(w) for w in cfg.CASCADE.BBOX_REG_WEIGHTS),
         )
 
     def setup(self):
@@ -113,8 +122,18 @@ class MaskRCNN(nn.Module):
         self.fpn = FPN(num_channels=self.fpn_channels, name="fpn")
         self.rpn_head = RPNHead(num_anchors=len(self.anchor_ratios),
                                 channels=self.fpn_channels, name="rpn")
-        self.box_head = BoxHead(num_classes=self.num_classes,
-                                fc_dim=self.fc_head_dim, name="fastrcnn")
+        if self.cascade:
+            from eksml_tpu.models.cascade import CascadeBoxHead
+
+            self.cascade_heads = [
+                CascadeBoxHead(num_classes=self.num_classes,
+                               fc_dim=self.fc_head_dim,
+                               name=f"cascade{i}")
+                for i in range(len(self.cascade_ious))]
+        else:
+            self.box_head = BoxHead(num_classes=self.num_classes,
+                                    fc_dim=self.fc_head_dim,
+                                    name="fastrcnn")
         if self.with_masks:
             self.mask_head = MaskHead(num_classes=self.num_classes,
                                       dim=self.mask_head_dim, name="maskrcnn")
@@ -199,33 +218,42 @@ class MaskRCNN(nn.Module):
                         batch["gt_classes"], batch["gt_valid"], gt_crowd,
                         rngs[:, 1])
 
-        # --- box head ---
-        roi_feats = batched_multilevel_roi_align(
-            feats[:4], rois, self.anchor_strides[:4], 7)
-        s = self.frcnn_batch_per_im
-        logits, deltas = self.box_head(
-            roi_feats.reshape(b * s, 7, 7, -1))
-        logits = logits.reshape(b, s, -1)
-        deltas = deltas.reshape(b, s, self.num_classes, 4)
-
-        frcnn_cls, frcnn_box = jax.vmap(
-            lambda lg, dl, r, rl, mg, gb, fm, vm: box_head_losses(
-                lg, dl, r, rl, mg, gb, fm, vm, self.bbox_reg_weights)
-        )(logits, deltas, rois, roi_labels, matched_gt, batch["gt_boxes"],
-          fg_mask, valid_mask)
-
         losses = {
             "rpn_cls_loss": rpn_cls.mean(),
             "rpn_box_loss": rpn_box.mean(),
-            "frcnn_cls_loss": frcnn_cls.mean(),
-            "frcnn_box_loss": frcnn_box.mean(),
         }
+
+        s = self.frcnn_batch_per_im
+        if self.cascade:
+            # cascade stages train on progressively refined/relabeled
+            # boxes, but the mask head keeps the STAGE-1 sampled
+            # proposals (TensorPack/Detectron2 semantics: the 0.7-IoU
+            # relabeling would starve mask positives early in training)
+            losses.update(self._cascade_train(
+                feats, rois, roi_labels, matched_gt, fg_mask, valid_mask,
+                batch))
+        else:
+            # --- box head ---
+            roi_feats = dispatch_roi_align(
+                feats[:4], rois, self.anchor_strides[:4], 7)
+            logits, deltas = self.box_head(
+                roi_feats.reshape(b * s, 7, 7, -1))
+            logits = logits.reshape(b, s, -1)
+            deltas = deltas.reshape(b, s, self.num_classes, 4)
+
+            frcnn_cls, frcnn_box = jax.vmap(
+                lambda lg, dl, r, rl, mg, gb, fm, vm: box_head_losses(
+                    lg, dl, r, rl, mg, gb, fm, vm, self.bbox_reg_weights)
+            )(logits, deltas, rois, roi_labels, matched_gt,
+              batch["gt_boxes"], fg_mask, valid_mask)
+            losses["frcnn_cls_loss"] = frcnn_cls.mean()
+            losses["frcnn_box_loss"] = frcnn_box.mean()
 
         # --- mask head ---
         if self.with_masks and "gt_masks" in batch:
             mr = self.mask_resolution
             ma = mr // 2  # deconv in the head doubles resolution
-            mask_feats = batched_multilevel_roi_align(
+            mask_feats = dispatch_roi_align(
                 feats[:4], rois, self.anchor_strides[:4], ma)
             mask_logits = self.mask_head(
                 mask_feats.reshape(b * s, ma, ma, -1))
@@ -238,6 +266,67 @@ class MaskRCNN(nn.Module):
 
         losses["total_loss"] = sum(losses.values())
         return losses
+
+    def _cascade_train(self, feats, rois, roi_labels, matched_gt, fg_mask,
+                       valid_mask, batch):
+        """3-stage cascade training (models/cascade.py): stage 1 on the
+        sampled proposals, later stages on refined boxes re-labeled at
+        their higher IoU threshold.  Returns the per-stage losses (the
+        caller's mask head stays on the stage-1 proposals)."""
+        from eksml_tpu.models.cascade import (cascade_stage_losses,
+                                              refine_boxes, relabel_rois)
+
+        b = rois.shape[0]
+        s = self.frcnn_batch_per_im
+        gt_crowd = batch.get("gt_crowd", jnp.zeros_like(batch["gt_valid"]))
+        losses = {}
+        for i, head in enumerate(self.cascade_heads):
+            roi_feats = dispatch_roi_align(
+                feats[:4], rois, self.anchor_strides[:4], 7)
+            logits, deltas = head(roi_feats.reshape(b * s, 7, 7, -1))
+            logits = logits.reshape(b, s, -1)
+            deltas = deltas.reshape(b, s, 4)
+
+            cls_l, box_l = jax.vmap(
+                lambda lg, dl, r, rl, mg, gb, fm, vm, i=i:
+                cascade_stage_losses(lg, dl, r, rl, mg, gb, fm, vm,
+                                     self.cascade_reg_weights[i])
+            )(logits, deltas, rois, roi_labels, matched_gt,
+              batch["gt_boxes"], fg_mask, valid_mask)
+            losses[f"cascade{i}_cls_loss"] = cls_l.mean()
+            losses[f"cascade{i}_box_loss"] = box_l.mean()
+
+            if i + 1 < len(self.cascade_heads):
+                rois = jax.vmap(
+                    lambda r, d, hw, i=i: refine_boxes(
+                        r, d, self.cascade_reg_weights[i], hw)
+                )(rois, deltas, batch["image_hw"])
+                roi_labels, matched_gt, fg_mask = jax.vmap(
+                    lambda r, gb, gc, gv, cr, i=i: relabel_rois(
+                        r, gb, gc, gv, cr, self.cascade_ious[i + 1])
+                )(rois, batch["gt_boxes"], batch["gt_classes"],
+                  batch["gt_valid"], gt_crowd)
+        return losses
+
+    def _cascade_predict(self, feats, prop_boxes, image_hw):
+        """Sequential refinement; class probabilities averaged over the
+        three stages (TensorPack CascadeRCNNHead semantics)."""
+        from eksml_tpu.models.cascade import refine_boxes
+
+        b, p = prop_boxes.shape[0], prop_boxes.shape[1]
+        boxes = prop_boxes
+        probs_sum = 0.0
+        for i, head in enumerate(self.cascade_heads):
+            roi_feats = dispatch_roi_align(
+                feats[:4], boxes, self.anchor_strides[:4], 7)
+            logits, deltas = head(roi_feats.reshape(b * p, 7, 7, -1))
+            probs_sum = probs_sum + jax.nn.softmax(
+                logits.reshape(b, p, -1), axis=-1)
+            boxes = jax.vmap(
+                lambda bx, d, hw, i=i: refine_boxes(
+                    bx, d.reshape(-1, 4), self.cascade_reg_weights[i], hw)
+            )(boxes, deltas.reshape(b, p, 4), image_hw)
+        return boxes, probs_sum / len(self.cascade_heads)
 
     def _mask_targets(self, rois, matched_gt, gt_boxes, gt_masks):
         """Resample bbox-cropped GT masks to per-ROI mask targets.
@@ -286,33 +375,49 @@ class MaskRCNN(nn.Module):
             self.test_pre_nms_topk, self.test_post_nms_topk)
 
         p = prop_boxes.shape[1]
-        roi_feats = batched_multilevel_roi_align(
-            feats[:4], prop_boxes, self.anchor_strides[:4], 7)
-        logits, deltas = self.box_head(roi_feats.reshape(b * p, 7, 7, -1))
-        probs = jax.nn.softmax(logits, axis=-1).reshape(b, p, -1)
-        deltas = deltas.reshape(b, p, self.num_classes, 4)
-
         d = self.test_results_per_im
 
-        def detect_one(props, prop_sc, prob, delta, hw):
-            # best foreground class per proposal (single-label decode —
-            # the fixed-output-shape variant of per-class decoding)
+        def select_detections(boxes_r, prop_sc, prob):
+            """Shared per-image postprocess: best-fg-class scoring,
+            validity/threshold masking, class-aware NMS → top-d."""
             fg_prob = prob[:, 1:]
             cls = fg_prob.argmax(axis=-1) + 1
             score = fg_prob.max(axis=-1)
-            sel_delta = jnp.take_along_axis(
-                delta, cls[:, None, None].repeat(4, -1), axis=1)[:, 0]
-            boxes = decode_boxes(sel_delta, props, self.bbox_reg_weights)
-            boxes = clip_boxes(boxes, hw[0], hw[1])
             score = jnp.where(jnp.isfinite(prop_sc), score, -jnp.inf)
             score = jnp.where(score >= self.test_score_thresh, score,
                               -jnp.inf)
             idx, top_sc, valid = class_aware_nms(
-                boxes, score, self.test_nms_thresh, d, class_ids=cls)
-            return boxes[idx], top_sc, cls[idx], valid, idx
+                boxes_r, score, self.test_nms_thresh, d, class_ids=cls)
+            return boxes_r[idx], top_sc, cls[idx], valid
 
-        boxes, scores, classes, valid, keep_idx = jax.vmap(detect_one)(
-            prop_boxes, prop_scores, probs, deltas, image_hw)
+        if self.cascade:
+            final_boxes, probs = self._cascade_predict(
+                feats, prop_boxes, image_hw)
+            boxes, scores, classes, valid = jax.vmap(select_detections)(
+                final_boxes, prop_scores, probs)
+        else:
+            roi_feats = dispatch_roi_align(
+                feats[:4], prop_boxes, self.anchor_strides[:4], 7)
+            logits, deltas = self.box_head(
+                roi_feats.reshape(b * p, 7, 7, -1))
+            probs = jax.nn.softmax(logits, axis=-1).reshape(b, p, -1)
+            deltas = deltas.reshape(b, p, self.num_classes, 4)
+
+            def decode_one(props, prob, delta, hw):
+                # best foreground class per proposal (single-label
+                # decode — the fixed-output-shape variant of per-class
+                # decoding)
+                cls = prob[:, 1:].argmax(axis=-1) + 1
+                sel_delta = jnp.take_along_axis(
+                    delta, cls[:, None, None].repeat(4, -1), axis=1)[:, 0]
+                boxes = decode_boxes(sel_delta, props,
+                                     self.bbox_reg_weights)
+                return clip_boxes(boxes, hw[0], hw[1])
+
+            decoded = jax.vmap(decode_one)(prop_boxes, probs, deltas,
+                                           image_hw)
+            boxes, scores, classes, valid = jax.vmap(select_detections)(
+                decoded, prop_scores, probs)
 
         out = {"boxes": boxes, "scores": scores, "classes": classes,
                "valid": valid}
@@ -320,7 +425,7 @@ class MaskRCNN(nn.Module):
         if self.with_masks:
             mr = self.mask_resolution
             ma = mr // 2
-            mask_feats = batched_multilevel_roi_align(
+            mask_feats = dispatch_roi_align(
                 feats[:4], boxes, self.anchor_strides[:4], ma)
             mask_logits = self.mask_head(
                 mask_feats.reshape(b * d, ma, ma, -1))

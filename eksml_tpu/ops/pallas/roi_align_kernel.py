@@ -1,0 +1,243 @@
+"""Pallas multilevel ROIAlign: per-ROI tile DMA + separable matmuls.
+
+Why a kernel (SURVEY.md §7 hard part #2): the XLA formulation in
+ops/roi_align.py must align every ROI on every FPN level (one-hot
+select keeps shapes static) and sample via gathers — 4× redundant work
+on a gather path the TPU executes poorly.  This kernel:
+
+- reads the per-ROI *assigned* level only (the 4× back);
+- replaces gathers with two MXU matmuls per ROI: bilinear
+  interpolation is separable, so sampling is
+  ``Ry @ tile @ Cx`` with ``Ry[s,t] = relu(1 - |y_s - t|)``
+  (row weights) and ``Cx`` likewise for columns — exactly the 2-tap
+  bilinear weights, built with iota arithmetic on the VPU;
+- DMAs one fixed ``T×T×C`` feature tile per ROI from HBM (grid is
+  sequential per core, so no write races), scalar-prefetching the
+  level/batch/origin indices.
+
+Semantics notes:
+- matches ``aligned=True`` ROIAlign with zero padding outside the
+  image, PROVIDED each level's feature map is spatially padded to at
+  least ``T`` (the caller pads; padding is zeros, which is exactly the
+  zero-padding ROIAlign wants);
+- ROIs whose extent at their assigned level exceeds ``T - 2`` pixels
+  are truncated to the tile (only pathological aspect ratios; the FPN
+  level heuristic bounds √area/stride ≤ ~56).
+
+The backward pass reuses the XLA formulation via ``jax.custom_vjp``
+(gather-grads become scatter-adds XLA already emits well); making the
+backward a kernel too is a further optimization, not a correctness
+need.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TILE = 64  # T: per-ROI feature tile (covers √area/stride ≲ 56 + taps)
+
+
+def pallas_roi_align_supported() -> bool:
+    """Kernel path is for real TPU backends; everything else falls
+    back to XLA (tests exercise the kernel via interpret=True)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _kernel(out_size: int, sampling: int, num_levels: int,
+            # scalar prefetch
+            lvl_ref, b_ref, y0_ref, x0_ref,
+            # VMEM per-roi float info [1, 8]:
+            # (y_start, x_start, bin_h, bin_w, 0, 0, 0, 0) tile-local
+            info_ref,
+            *refs):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    feat_refs = refs[:num_levels]          # HBM [B, Hp, Wp, C] each
+    out_ref = refs[num_levels]             # VMEM [1, out, out, C]
+    tile_ref = refs[num_levels + 1]        # VMEM scratch [T, T, C]
+    sem = refs[num_levels + 2]             # DMA semaphore
+
+    r = pl.program_id(0)
+    lvl = lvl_ref[r]
+    b = b_ref[r]
+    y0 = y0_ref[r]
+    x0 = x0_ref[r]
+
+    for i in range(num_levels):
+        @pl.when(lvl == i)
+        def _(i=i):
+            dma = pltpu.make_async_copy(
+                feat_refs[i].at[b, pl.ds(y0, TILE), pl.ds(x0, TILE), :],
+                tile_ref, sem)
+            dma.start()
+            dma.wait()
+
+    y_start = info_ref[0, 0]
+    x_start = info_ref[0, 1]
+    bin_h = info_ref[0, 2]
+    bin_w = info_ref[0, 3]
+
+    s_total = out_size * sampling
+    f32 = jnp.float32
+
+    def weights(start, binsz):
+        """[S, T] two-tap bilinear weight matrix for sample coords
+        start + (bin + (j+0.5)/sampling) * binsz."""
+        s_idx = jax.lax.broadcasted_iota(f32, (s_total, TILE), 0)
+        t_idx = jax.lax.broadcasted_iota(f32, (s_total, TILE), 1)
+        bins = jnp.floor(s_idx / sampling)
+        off = (s_idx - bins * sampling + 0.5) / sampling
+        coord = start + (bins + off) * binsz
+        return jnp.maximum(0.0, 1.0 - jnp.abs(coord - t_idx))
+
+    ry = weights(y_start, bin_h)                    # [S, T]
+    cx = weights(x_start, bin_w)                    # [S, T]
+
+    tile = tile_ref[:].astype(f32)                  # [T, T, C]
+    c = tile.shape[-1]
+    # rows: [S, T] @ [T, T*C] → [S, T, C]
+    rows = jnp.dot(ry, tile.reshape(TILE, TILE * c),
+                   preferred_element_type=f32).reshape(s_total, TILE, c)
+    # cols: contract T with cx → [S, S, C]
+    sampled = jax.lax.dot_general(
+        rows, cx.T,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=f32)                 # [S, C, S]
+    sampled = sampled.transpose(0, 2, 1)            # [S, S, C]
+    pooled = sampled.reshape(out_size, sampling, out_size, sampling,
+                             c).mean(axis=(1, 3))
+    out_ref[0] = pooled.astype(out_ref.dtype)
+
+
+def _prep(feats, rois, strides, out_size, min_level):
+    """Host-side (traced) index/weight prep: level assignment, clamped
+    tile origins, tile-local sample-start coordinates."""
+    from eksml_tpu.ops.roi_align import assign_fpn_levels
+
+    b, n = rois.shape[0], rois.shape[1]
+    flat = rois.reshape(b * n, 4)
+    levels = assign_fpn_levels(
+        flat, min_level=min_level,
+        max_level=min_level + len(feats) - 1) - min_level   # [BN] in [0,L)
+    batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.int32), n)
+
+    inv_strides = jnp.asarray([1.0 / s for s in strides], jnp.float32)
+    scale = inv_strides[levels]                              # [BN]
+    x1 = flat[:, 0] * scale
+    y1 = flat[:, 1] * scale
+    x2 = flat[:, 2] * scale
+    y2 = flat[:, 3] * scale
+    bin_h = jnp.maximum(y2 - y1, 1e-4) / out_size
+    bin_w = jnp.maximum(x2 - x1, 1e-4) / out_size
+
+    h_pad = jnp.asarray([f.shape[1] for f in feats], jnp.int32)[levels]
+    w_pad = jnp.asarray([f.shape[2] for f in feats], jnp.int32)[levels]
+    # aligned=True: samples start at y1 - 0.5; tile origin 1 tap early
+    y0 = jnp.clip(jnp.floor(y1 - 1.5).astype(jnp.int32), 0,
+                  jnp.maximum(h_pad - TILE, 0))
+    x0 = jnp.clip(jnp.floor(x1 - 1.5).astype(jnp.int32), 0,
+                  jnp.maximum(w_pad - TILE, 0))
+
+    info = jnp.stack([
+        y1 - 0.5 + 0.0 - y0.astype(jnp.float32),
+        x1 - 0.5 + 0.0 - x0.astype(jnp.float32),
+        bin_h, bin_w,
+        jnp.zeros_like(bin_h), jnp.zeros_like(bin_h),
+        jnp.zeros_like(bin_h), jnp.zeros_like(bin_h)], axis=-1)
+    return levels.astype(jnp.int32), batch_idx, y0, x0, info
+
+
+def _pad_levels(feats):
+    """Zero-pad each level's spatial dims to ≥ TILE (zero padding IS
+    ROIAlign's out-of-image semantics, so this is free correctness)."""
+    out = []
+    for f in feats:
+        _, h, w, _ = f.shape
+        ph, pw = max(TILE - h, 0), max(TILE - w, 0)
+        if ph or pw:
+            f = jnp.pad(f, ((0, 0), (0, ph), (0, pw), (0, 0)))
+        out.append(f)
+    return out
+
+
+def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
+                    interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    feats = _pad_levels(feats)
+    b, n = rois.shape[0], rois.shape[1]
+    c = feats[0].shape[-1]
+    levels, batch_idx, y0, x0, info = _prep(feats, rois, strides,
+                                            out_size, min_level)
+    num_levels = len(feats)
+    kern = functools.partial(_kernel, out_size, sampling, num_levels)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b * n,),
+        in_specs=[
+            pl.BlockSpec((1, 8), lambda r, *_: (r, 0),
+                         memory_space=pltpu.VMEM),
+        ] + [pl.BlockSpec(memory_space=pltpu.ANY)] * num_levels,
+        out_specs=pl.BlockSpec((1, out_size, out_size, c),
+                               lambda r, *_: (r, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((TILE, TILE, c), feats[0].dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * n, out_size, out_size, c),
+                                       feats[0].dtype),
+        interpret=interpret,
+    )(levels, batch_idx, y0, x0, info, *feats)
+    return out.reshape(b, n, out_size, out_size, c)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def pallas_batched_multilevel_roi_align(
+        feats, rois, strides: Sequence[int], out_size: int,
+        sampling_ratio: int = 2, min_level: int = 2,
+        interpret: bool = False):
+    """Drop-in for ops.roi_align.batched_multilevel_roi_align:
+    feats ``[(B, Hl, Wl, C), ...]``, rois ``[B, N, 4]`` →
+    ``[B, N, out, out, C]``.  Pallas forward, XLA backward."""
+    return _pallas_forward(tuple(feats), rois, strides, out_size,
+                           sampling_ratio, min_level, interpret)
+
+
+def _fwd(feats, rois, strides, out_size, sampling_ratio, min_level,
+         interpret):
+    out = _pallas_forward(tuple(feats), rois, strides, out_size,
+                          sampling_ratio, min_level, interpret)
+    return out, (tuple(feats), rois)
+
+
+def _bwd(strides, out_size, sampling_ratio, min_level, interpret, res, g):
+    """Backward through the XLA formulation (identical math up to the
+    tile-truncation edge case); scatter-add grads XLA handles well."""
+    from eksml_tpu.ops.roi_align import batched_multilevel_roi_align
+
+    feats, rois = res
+    _, vjp = jax.vjp(
+        lambda fs: batched_multilevel_roi_align(
+            fs, rois, strides, out_size, sampling_ratio, min_level),
+        feats)
+    (g_feats,) = vjp(g)
+    return g_feats, jnp.zeros_like(rois)
+
+
+pallas_batched_multilevel_roi_align.defvjp(_fwd, _bwd)

@@ -121,3 +121,19 @@ def batched_multilevel_roi_align(feats, rois, strides, out_size,
                                            sampling_ratio, min_level),
         in_axes=(0, 0))
     return fn(tuple(feats), rois)
+
+
+def dispatch_roi_align(feats, rois, strides, out_size,
+                       sampling_ratio: int = 2, min_level: int = 2):
+    """Backend dispatch: the Pallas kernel on real TPU (assigned-level
+    tile DMA + separable MXU matmuls, ops/pallas/roi_align_kernel.py),
+    the XLA gather formulation elsewhere."""
+    from eksml_tpu.ops.pallas import (pallas_batched_multilevel_roi_align,
+                                      pallas_roi_align_supported)
+
+    if pallas_roi_align_supported():
+        return pallas_batched_multilevel_roi_align(
+            tuple(feats), rois, tuple(strides), out_size, sampling_ratio,
+            min_level)
+    return batched_multilevel_roi_align(feats, rois, strides, out_size,
+                                        sampling_ratio, min_level)

@@ -43,6 +43,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
             log.debug("topology shim build failed: %s", e)
         if not os.path.exists(_LIB_PATH):
             return None
+        if _stale():
+            log.warning("topology.cc changed but rebuild failed; NOT "
+                        "loading the stale %s — using python fallback",
+                        _LIB_PATH)
+            return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -59,7 +64,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                                 ctypes.c_int32]
         lib.combine_threshold_bytes.restype = ctypes.c_int64
         _lib = lib
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError: symbol mismatch (old binary / changed ABI)
         log.warning("failed to load %s: %s", _LIB_PATH, e)
     return _lib
 

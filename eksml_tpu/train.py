@@ -275,6 +275,13 @@ class Trainer:
                          total_steps, metrics["total_loss"],
                          metrics["images_per_sec"])
 
+            sync_every = cfg.TRAIN.SYNC_CHECK_PERIOD
+            if sync_every and step % sync_every == 0:
+                from eksml_tpu.parallel.collectives import \
+                    assert_replicas_in_sync
+
+                assert_replicas_in_sync(state.params, self.mesh)
+
             if step % ckpt_every == 0 or step == total_steps:
                 self.ckpt.save(step, jax.tree.map(np.asarray, state))
             if self.eval_fn and (step % eval_every == 0

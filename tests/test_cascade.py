@@ -1,0 +1,90 @@
+"""Cascade R-CNN (MODE_CASCADE) — training losses + inference shapes.
+
+Parity target: TensorPack CascadeRCNNHead semantics (BASELINE.json
+configs[4]); these pin the TPU-first re-expression in models/cascade.py:
+3 per-stage loss pairs, static ROI set through all stages, averaged
+stage probabilities at test time.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from eksml_tpu.models import MaskRCNN
+from eksml_tpu.models.cascade import relabel_rois, refine_boxes
+
+
+def _tiny(cfg):
+    cfg.MODE_CASCADE = True
+    cfg.PREPROC.MAX_SIZE = 128
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (128, 128)
+    cfg.DATA.MAX_GT_BOXES = 8
+    cfg.RPN.TRAIN_PRE_NMS_TOPK = 64
+    cfg.RPN.TRAIN_POST_NMS_TOPK = 32
+    cfg.RPN.TEST_PRE_NMS_TOPK = 64
+    cfg.RPN.TEST_POST_NMS_TOPK = 32
+    cfg.FRCNN.BATCH_PER_IM = 16
+    cfg.FPN.NUM_CHANNEL = 32
+    cfg.FPN.FRCNN_FC_HEAD_DIM = 64
+    cfg.MRCNN.HEAD_DIM = 16
+    cfg.BACKBONE.RESNET_NUM_BLOCKS = (1, 1, 1, 1)
+    cfg.TEST.RESULTS_PER_IM = 8
+    return cfg
+
+
+def test_relabel_thresholds():
+    rois = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [0, 0, 6, 10]], jnp.float32)
+    gt = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+    labels, matched, fg = relabel_rois(
+        rois, gt, jnp.asarray([3]), jnp.asarray([1.0]),
+        jnp.asarray([0.0]), 0.6)
+    # exact match → fg; disjoint → bg; IoU 0.6 box → fg at 0.6
+    assert labels.tolist() == [3, 0, 3]
+    assert fg.tolist() == [True, False, True]
+    labels7, _, fg7 = relabel_rois(
+        rois, gt, jnp.asarray([3]), jnp.asarray([1.0]),
+        jnp.asarray([0.0]), 0.7)
+    assert fg7.tolist() == [True, False, False]  # 0.6 box fails at 0.7
+
+
+def test_refine_boxes_clips_and_stops_gradient():
+    rois = jnp.asarray([[10.0, 10.0, 50.0, 50.0]])
+    deltas = jnp.asarray([[0.0, 0.0, 0.0, 0.0]])
+    out = refine_boxes(rois, deltas, (10., 10., 5., 5.), (40.0, 40.0))
+    np.testing.assert_allclose(np.asarray(out), [[10, 10, 40, 40]])
+
+
+@pytest.mark.slow
+def test_cascade_train_and_predict(fresh_config):
+    from eksml_tpu.data.loader import make_synthetic_batch
+
+    cfg = _tiny(fresh_config)
+    cfg.freeze()
+    model = MaskRCNN.from_config(cfg)
+    assert model.cascade
+
+    batch = make_synthetic_batch(cfg, batch_size=1, image_size=128,
+                                 gt_mask_size=28)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, batch, rng)["params"]
+    assert "cascade0" in params and "cascade2" in params
+    assert "fastrcnn" not in params
+
+    losses = jax.jit(lambda p, b, r: model.apply({"params": p}, b, r))(
+        params, batch, rng)
+    for i in range(3):
+        assert np.isfinite(float(losses[f"cascade{i}_cls_loss"]))
+        assert np.isfinite(float(losses[f"cascade{i}_box_loss"]))
+    assert np.isfinite(float(losses["total_loss"]))
+
+    out = jax.jit(lambda p, im, hw: model.apply(
+        {"params": p}, im, hw, method=MaskRCNN.predict))(
+        params, batch["images"], batch["image_hw"])
+    d = cfg.TEST.RESULTS_PER_IM
+    assert out["boxes"].shape == (1, d, 4)
+    assert out["masks"].shape[1] == d
+    assert np.isfinite(np.asarray(out["boxes"])).all()

@@ -1,0 +1,54 @@
+"""Golden-value regression test (SURVEY.md §4: 'golden-value tests for
+loss on fixed batches' — coverage the reference has no way to express).
+
+The values pin the full training forward (anchors → matching →
+sampling → ROIAlign → heads → losses) on a fixed synthetic batch with
+fixed init/sampling seeds.  A drift here means the numerics changed —
+intentional changes must re-derive the goldens (tools in the docstring
+of this file's git history).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from eksml_tpu.data.loader import make_synthetic_batch
+from eksml_tpu.models import MaskRCNN
+
+GOLDEN = {
+    "frcnn_box_loss": 0.698781,
+    "frcnn_cls_loss": 4.683722,
+    "mrcnn_loss": 0.682699,
+    "rpn_box_loss": 0.353808,
+    "rpn_cls_loss": 0.996330,
+    "total_loss": 7.415341,
+}
+
+
+@pytest.mark.slow
+def test_training_losses_match_golden(fresh_config):
+    cfg = fresh_config
+    cfg.PREPROC.MAX_SIZE = 128
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (128, 128)
+    cfg.DATA.MAX_GT_BOXES = 8
+    cfg.RPN.TRAIN_PRE_NMS_TOPK = 64
+    cfg.RPN.TRAIN_POST_NMS_TOPK = 32
+    cfg.FRCNN.BATCH_PER_IM = 16
+    cfg.FPN.NUM_CHANNEL = 32
+    cfg.FPN.FRCNN_FC_HEAD_DIM = 64
+    cfg.MRCNN.HEAD_DIM = 16
+    cfg.BACKBONE.RESNET_NUM_BLOCKS = (1, 1, 1, 1)
+    cfg.freeze()
+
+    model = MaskRCNN.from_config(cfg)
+    batch = make_synthetic_batch(cfg, batch_size=1, image_size=128,
+                                 seed=7, gt_mask_size=28)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+    rng = jax.random.PRNGKey(42)
+    params = model.init(rng, batch, rng)["params"]
+    losses = model.apply({"params": params}, batch, rng)
+    for k, want in GOLDEN.items():
+        got = float(losses[k])
+        assert got == pytest.approx(want, abs=2e-3), (k, got, want)

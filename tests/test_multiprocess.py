@@ -1,0 +1,98 @@
+"""Real multi-process distributed test: 2 host processes × 4 CPU
+devices rendezvous through ``jax.distributed.initialize`` — the same
+code path the JobSet chart drives via COORDINATOR_ADDRESS /
+NUM_PROCESSES / PROCESS_ID env (SURVEY.md §4: the reference can only
+test multi-node on a live cluster; this runs anywhere).
+
+Each worker: initialize_from_env → 8-device global mesh → a jitted
+global mean over a batch sharded across BOTH processes (XLA inserts the
+cross-process allreduce) → cross_host_sum of distinct per-host metrics.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import os, sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from eksml_tpu.parallel import initialize_from_env, build_mesh, \
+    batch_sharding, cross_host_sum
+
+initialize_from_env()
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+mesh = build_mesh()
+pid = jax.process_index()
+
+# global batch 8 rows, each host contributes rows [4*pid, 4*pid+4)
+local = np.arange(4 * pid, 4 * pid + 4, dtype=np.float32).reshape(4, 1)
+global_x = multihost_utils.host_local_array_to_global_array(
+    local, mesh, jax.sharding.PartitionSpec("data"))
+
+mean = jax.jit(jnp.mean, out_shardings=jax.sharding.NamedSharding(
+    mesh, jax.sharding.PartitionSpec()))(global_x)
+# replicated output: read this host's shard
+got = float(np.asarray(mean.addressable_shards[0].data))
+assert abs(got - 3.5) < 1e-6, got  # mean of 0..7 — needs both hosts
+
+# host-local metric sum: host 0 contributes 1.0, host 1 contributes 2.0
+total = cross_host_sum({"loss": jnp.asarray(float(pid) + 1.0)})
+assert abs(float(total["loss"]) - 3.0) < 1e-6, total
+
+print(f"worker {pid} OK", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_rendezvous_and_collectives(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+    port = _free_port()
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": str(pid),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": repo,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker_py)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed workers timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"worker {pid} OK" in out

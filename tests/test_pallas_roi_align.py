@@ -1,0 +1,101 @@
+"""Pallas ROIAlign kernel vs the XLA reference formulation.
+
+Runs in interpret mode (no TPU in the test environment, SURVEY.md §4);
+the kernel's math — assigned-level tile DMA + separable two-tap
+bilinear matmuls — must agree with ops.roi_align's gather formulation
+everywhere the tile covers the ROI.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from eksml_tpu.ops.roi_align import batched_multilevel_roi_align
+from eksml_tpu.ops.pallas.roi_align_kernel import (
+    TILE, pallas_batched_multilevel_roi_align)
+
+STRIDES = (4, 8, 16, 32)
+
+
+def _feats(rng, b=1, img=128, c=32):
+    return tuple(
+        jnp.asarray(rng.randn(b, img // s, img // s, c).astype(np.float32))
+        for s in STRIDES)
+
+
+def _rois(rng, b, n, img=128):
+    out = []
+    for _ in range(b):
+        ctr = rng.rand(n, 2) * img * 0.5 + img * 0.25
+        size = np.exp(rng.rand(n) * np.log(20)) * 4
+        ar = np.exp(rng.randn(n) * 0.3)
+        w, h = size * ar, size / ar
+        x1 = np.clip(ctr[:, 0] - w / 2, 1, img - 2)
+        y1 = np.clip(ctr[:, 1] - h / 2, 1, img - 2)
+        x2 = np.clip(x1 + w, None, img - 2)
+        y2 = np.clip(y1 + h, None, img - 2)
+        out.append(np.stack([x1, y1, x2, y2], 1))
+    return jnp.asarray(np.stack(out).astype(np.float32))
+
+
+def test_matches_xla_reference():
+    rng = np.random.RandomState(0)
+    feats = _feats(rng, b=2)
+    rois = _rois(rng, 2, 12)
+    ref = batched_multilevel_roi_align(feats, rois, STRIDES, 7)
+    pal = pallas_batched_multilevel_roi_align(feats, rois, STRIDES, 7, 2,
+                                              2, True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=1e-4)
+
+
+def test_mask_head_resolution():
+    rng = np.random.RandomState(1)
+    feats = _feats(rng)
+    rois = _rois(rng, 1, 6)
+    ref = batched_multilevel_roi_align(feats, rois, STRIDES, 14)
+    pal = pallas_batched_multilevel_roi_align(feats, rois, STRIDES, 14, 2,
+                                              2, True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=1e-4)
+
+
+def test_border_roi_zero_padding():
+    # ROI hugging the image corner: zero-padding outside the image must
+    # match the XLA formulation's out-of-range-taps-are-zero rule
+    rng = np.random.RandomState(2)
+    feats = _feats(rng)
+    rois = jnp.asarray([[[0.0, 0.0, 12.0, 9.0]]], jnp.float32)
+    ref = batched_multilevel_roi_align(feats, rois, STRIDES, 7)
+    pal = pallas_batched_multilevel_roi_align(feats, rois, STRIDES, 7, 2,
+                                              2, True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=1e-4)
+
+
+def test_small_level_padding():
+    # P5 of a 128px image is 4x4 < TILE: _pad_levels must zero-extend
+    # and big ROIs (assigned to P5) must still match
+    rng = np.random.RandomState(3)
+    feats = _feats(rng)
+    assert feats[-1].shape[1] < TILE
+    rois = jnp.asarray([[[4.0, 8.0, 120.0, 116.0]]], jnp.float32)  # huge
+    ref = batched_multilevel_roi_align(feats, rois, STRIDES, 7)
+    pal = pallas_batched_multilevel_roi_align(feats, rois, STRIDES, 7, 2,
+                                              2, True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=1e-4)
+
+
+def test_gradient_matches_reference():
+    rng = np.random.RandomState(4)
+    feats = _feats(rng, c=8)
+    rois = _rois(rng, 1, 5)
+
+    gp = jax.grad(lambda fs: pallas_batched_multilevel_roi_align(
+        fs, rois, STRIDES, 7, 2, 2, True).sum())(feats)
+    gr = jax.grad(lambda fs: batched_multilevel_roi_align(
+        fs, rois, STRIDES, 7).sum())(feats)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)

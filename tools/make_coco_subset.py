@@ -1,0 +1,60 @@
+"""Build the COCO-2017 N-image subset for the run.sh smoke
+(BASELINE.json configs[0]: 'COCO-2017 100-image subset, single-process
+CPU').  Writes a self-contained dataset directory with the reference's
+staged layout (train2017/ val2017/ annotations/ — reference
+eks-cluster/stage-data.yaml:30-36 contract), so DATA.BASEDIR can point
+straight at it.
+
+Usage::
+
+    python tools/make_coco_subset.py --src /efs/data --dst /efs/data-100 \
+        --num-train 100 --num-val 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+
+
+def subset_split(src: str, dst: str, split: str, n: int) -> None:
+    ann_path = os.path.join(src, "annotations", f"instances_{split}.json")
+    with open(ann_path) as f:
+        data = json.load(f)
+    images = sorted(data["images"], key=lambda im: im["id"])[:n]
+    keep = {im["id"] for im in images}
+    anns = [a for a in data["annotations"] if a["image_id"] in keep]
+
+    os.makedirs(os.path.join(dst, split), exist_ok=True)
+    os.makedirs(os.path.join(dst, "annotations"), exist_ok=True)
+    for im in images:
+        shutil.copy2(os.path.join(src, split, im["file_name"]),
+                     os.path.join(dst, split, im["file_name"]))
+    with open(os.path.join(dst, "annotations",
+                           f"instances_{split}.json"), "w") as f:
+        json.dump({"images": images, "annotations": anns,
+                   "categories": data["categories"]}, f)
+    print(f"{split}: {len(images)} images, {len(anns)} annotations")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--src", required=True, help="full COCO basedir")
+    p.add_argument("--dst", required=True)
+    p.add_argument("--num-train", type=int, default=100)
+    p.add_argument("--num-val", type=int, default=20)
+    args = p.parse_args()
+    subset_split(args.src, args.dst, "train2017", args.num_train)
+    subset_split(args.src, args.dst, "val2017", args.num_val)
+    pre_src = os.path.join(args.src, "pretrained-models")
+    if os.path.isdir(pre_src):
+        shutil.copytree(pre_src, os.path.join(args.dst,
+                                              "pretrained-models"),
+                        dirs_exist_ok=True)
+    print(f"subset ready at {args.dst}")
+
+
+if __name__ == "__main__":
+    main()
