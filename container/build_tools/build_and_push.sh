@@ -5,16 +5,20 @@
 # Artifact Registry.  Works for both the training image (default) and
 # the viz image (IMAGE_KIND=viz).
 #
-# Usage: [REGION=us-central1] [IMAGE_KIND=train|viz] bash build_and_push.sh
+# Usage: [REGION=us-central1] [IMAGE_KIND=train|viz|optimized|optimized-viz]
+#        bash build_and_push.sh
 
 set -e
 cd "$(dirname "$0")"
-source ./set_env.sh
+IMAGE_KIND=${IMAGE_KIND:-train}
+case "$IMAGE_KIND" in
+  optimized|optimized-viz) source ../../container-optimized/build_tools/set_env.sh ;;
+  *) source ./set_env.sh ;;
+esac
 
 REGION=${REGION:-us-central1}
 PROJECT=${PROJECT:-$(gcloud config get-value project 2>/dev/null)}
 REPO=${REPO:-eksml-tpu}
-IMAGE_KIND=${IMAGE_KIND:-train}
 REGISTRY="${REGION}-docker.pkg.dev/${PROJECT}/${REPO}"
 
 # create-repo-if-missing ≙ reference build_and_push.sh:36-41
@@ -27,15 +31,32 @@ gcloud artifacts repositories describe "$REPO" \
 gcloud auth configure-docker "${REGION}-docker.pkg.dev" --quiet
 
 REPO_ROOT="$(cd ../.. && pwd)"
-if [ "$IMAGE_KIND" = "viz" ]; then
-  IMAGE="${REGISTRY}/${IMAGE_NAME}-viz:${IMAGE_TAG}"
-  docker build -t "$IMAGE" \
-    --build-arg BASE_IMAGE="${REGISTRY}/${IMAGE_NAME}:${IMAGE_TAG}" \
-    -f "$REPO_ROOT/container-viz/Dockerfile" "$REPO_ROOT"
-else
-  IMAGE="${REGISTRY}/${IMAGE_NAME}:${IMAGE_TAG}"
-  docker build -t "$IMAGE" -f "$REPO_ROOT/container/Dockerfile" "$REPO_ROOT"
-fi
+TRAIN_BASE="${REGISTRY}/eksml-tpu-train:${IMAGE_TAG}"
+case "$IMAGE_KIND" in
+  viz)
+    IMAGE="${REGISTRY}/${IMAGE_NAME}-viz:${IMAGE_TAG}"
+    docker build -t "$IMAGE" --build-arg BASE_IMAGE="$TRAIN_BASE" \
+      -f "$REPO_ROOT/container-viz/Dockerfile" "$REPO_ROOT"
+    ;;
+  optimized)
+    IMAGE="${REGISTRY}/${IMAGE_NAME}:${IMAGE_TAG}"
+    docker build -t "$IMAGE" --build-arg BASE_IMAGE="$TRAIN_BASE" \
+      -f "$REPO_ROOT/container-optimized/Dockerfile" "$REPO_ROOT"
+    ;;
+  optimized-viz)
+    IMAGE="${REGISTRY}/${IMAGE_NAME}-viz:${IMAGE_TAG}"
+    docker build -t "$IMAGE" \
+      --build-arg BASE_IMAGE="${REGISTRY}/${IMAGE_NAME}:${IMAGE_TAG}" \
+      -f "$REPO_ROOT/container-optimized-viz/Dockerfile" "$REPO_ROOT"
+    ;;
+  train)
+    IMAGE="${REGISTRY}/${IMAGE_NAME}:${IMAGE_TAG}"
+    docker build -t "$IMAGE" -f "$REPO_ROOT/container/Dockerfile" "$REPO_ROOT"
+    ;;
+  *)
+    echo "unknown IMAGE_KIND=$IMAGE_KIND" >&2; exit 1
+    ;;
+esac
 
 docker push "$IMAGE"
 echo "$IMAGE"

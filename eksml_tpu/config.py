@@ -306,6 +306,15 @@ def config_from_env(cfg: AttrDict = None) -> AttrDict:
     """
     cfg = cfg or _C
     cfg.freeze(False)
+    # optimized-image baked defaults (container-optimized/Dockerfile):
+    # the operating point the reference baked into its optimized fork
+    # (fp16/batch-4); explicit --config overrides still win because
+    # they are applied after config_from_env in train.main
+    if os.environ.get("EKSML_DEFAULT_PRECISION"):
+        cfg.TRAIN.PRECISION = os.environ["EKSML_DEFAULT_PRECISION"]
+    if os.environ.get("EKSML_DEFAULT_BATCH_PER_CHIP"):
+        cfg.TRAIN.BATCH_SIZE_PER_CHIP = int(
+            os.environ["EKSML_DEFAULT_BATCH_PER_CHIP"])
     cfg.TPU.COORDINATOR_ADDRESS = os.environ.get(
         "COORDINATOR_ADDRESS", cfg.TPU.COORDINATOR_ADDRESS)
     cfg.TPU.NUM_PROCESSES = int(os.environ.get(

@@ -231,7 +231,7 @@ class MaskRCNN(nn.Module):
             # relabeling would starve mask positives early in training)
             losses.update(self._cascade_train(
                 feats, rois, roi_labels, matched_gt, fg_mask, valid_mask,
-                batch))
+                batch, gt_crowd))
         else:
             # --- box head ---
             roi_feats = dispatch_roi_align(
@@ -268,7 +268,7 @@ class MaskRCNN(nn.Module):
         return losses
 
     def _cascade_train(self, feats, rois, roi_labels, matched_gt, fg_mask,
-                       valid_mask, batch):
+                       valid_mask, batch, gt_crowd):
         """3-stage cascade training (models/cascade.py): stage 1 on the
         sampled proposals, later stages on refined boxes re-labeled at
         their higher IoU threshold.  Returns the per-stage losses (the
@@ -278,7 +278,6 @@ class MaskRCNN(nn.Module):
 
         b = rois.shape[0]
         s = self.frcnn_batch_per_im
-        gt_crowd = batch.get("gt_crowd", jnp.zeros_like(batch["gt_valid"]))
         losses = {}
         for i, head in enumerate(self.cascade_heads):
             roi_feats = dispatch_roi_align(

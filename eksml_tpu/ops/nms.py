@@ -28,13 +28,51 @@ from eksml_tpu.ops.boxes import pairwise_iou
 
 def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
              iou_threshold: float) -> jnp.ndarray:
-    """Greedy NMS keep-mask for pre-sorted-or-not boxes ``[K, 4]``.
+    """Greedy NMS keep-mask for boxes ``[K, 4]`` (any order).
 
     Returns a bool ``[K]`` mask in the *input* order.  Padding entries
-    should have ``scores = -inf``; they never suppress anything (their
-    IoU with real boxes is 0 when boxes are zeros) and are excluded from
-    the keep mask.
+    should have ``scores = -inf``; they never suppress anything and are
+    excluded from the keep mask.
+
+    TPU formulation: instead of K sequential greedy steps (the CUDA
+    shape of the reference's TF kernel), iterate the fixed point
+
+        keep_i ← valid_i ∧ ¬∃j:  rank_j < rank_i ∧ IoU(j,i) > t ∧ keep_j
+
+    synchronously until unchanged.  Each sweep is one [K,K] masked
+    reduction (VPU-wide); the loop runs for the longest suppression
+    *chain* (typically < 16) rather than K (2000 for RPN proposals),
+    and the fixed point equals exact greedy NMS
+    (tests/test_nms.py cross-checks the sequential recurrence).
     """
+    k = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sboxes = boxes[order]
+    svalid = jnp.isfinite(scores[order])
+    iou = pairwise_iou(sboxes, sboxes)
+    rank = jnp.arange(k)
+    # sup[j, i]: j would suppress i if j is kept
+    sup = (iou > iou_threshold) & (rank[:, None] < rank[None, :])
+
+    def cond(state):
+        keep, prev, it = state
+        return (it < k) & jnp.any(keep != prev)
+
+    def body(state):
+        keep, _, it = state
+        new = svalid & ~jnp.any(sup & keep[:, None], axis=0)
+        return new, keep, it + 1
+
+    keep_sorted, _, _ = jax.lax.while_loop(
+        cond, body, (svalid, jnp.zeros_like(svalid), jnp.zeros((), jnp.int32)))
+    # scatter back to input order
+    return jnp.zeros((k,), dtype=bool).at[order].set(keep_sorted)
+
+
+def nms_mask_sequential(boxes: jnp.ndarray, scores: jnp.ndarray,
+                        iou_threshold: float) -> jnp.ndarray:
+    """Reference O(K)-step greedy recurrence (the textbook algorithm);
+    kept for cross-checking the fixed-point formulation above."""
     k = boxes.shape[0]
     order = jnp.argsort(-scores)
     sboxes = boxes[order]
@@ -42,16 +80,12 @@ def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
     iou = pairwise_iou(sboxes, sboxes)
 
     def body(i, keep):
-        # Box i survives iff no earlier kept box overlaps it too much.
         kept_i = keep[i]
         suppress = (iou[i] > iou_threshold) & (jnp.arange(k) > i) & kept_i
         return keep & ~suppress
 
-    keep0 = svalid
-    keep_sorted = jax.lax.fori_loop(0, k, body, keep0)
-    # scatter back to input order
-    keep = jnp.zeros((k,), dtype=bool).at[order].set(keep_sorted)
-    return keep
+    keep_sorted = jax.lax.fori_loop(0, k, body, svalid)
+    return jnp.zeros((k,), dtype=bool).at[order].set(keep_sorted)
 
 
 @partial(jax.jit, static_argnames=("max_outputs", "iou_threshold"))

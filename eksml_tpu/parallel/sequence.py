@@ -1,0 +1,180 @@
+"""Sequence/context parallelism: ring attention over a mesh axis.
+
+The reference has no sequence dimension at all (SURVEY.md §5.7 — it is
+a CNN detector), but its comm layer (NCCL/Horovod) was the piece that
+would have had to carry one.  This module is the TPU-native comm-layer
+capability: attention over sequences sharded across chips, so context
+length scales with the slice instead of per-chip HBM.
+
+Two standard formulations, both pure ``shard_map`` + XLA collectives
+over ICI:
+
+- :func:`ring_attention` — blockwise attention with K/V blocks rotated
+  around the ring by ``ppermute`` (Liu et al., Ring Attention).  Each
+  of the N steps overlaps compute on the resident block with the
+  transfer of the next; softmax runs in the streaming (flash) form with
+  running max/denominator, so nothing materializes the full [S, S]
+  score matrix.
+- :func:`ulysses_attention` — all-to-all re-partition: sequence-sharded
+  Q/K/V → head-sharded full sequences → local attention → all-to-all
+  back (DeepSpeed-Ulysses).  Cheaper collectives for models whose head
+  count ≥ ring size; ring wins when S is huge and heads are few.
+
+Both are exact (== single-device attention) and differentiable; tests
+verify on the 8-device CPU mesh (tests/test_sequence_parallel.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _local_flash_block(q, k, v, m_prev, l_prev, o_prev, scale,
+                       causal_mask=None):
+    """One streaming-softmax accumulation step.
+
+    q [Sq, H, D]; k/v [Sk, H, D]; running stats m/l [H, Sq], o [Sq, H, D].
+    """
+    # scores [H, Sq, Sk]
+    s = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -jnp.inf)
+    m_cur = s.max(axis=-1)                          # [H, Sq]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard: fully-masked rows have m == -inf; exp(-inf - -inf) → nan
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(jnp.where(jnp.isfinite(s), s - safe_m[..., None], -jnp.inf))
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m_prev), m_prev - safe_m, -jnp.inf))
+    alpha = jnp.where(jnp.isnan(alpha), 0.0, alpha)
+    l_new = l_prev * alpha + p.sum(axis=-1)
+    o_new = o_prev * alpha.transpose(1, 0)[..., None]  # [Sq, H, 1]
+    o_new = o_new + jnp.einsum("hqk,khd->qhd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, axis: str = "data",
+                   causal: bool = False) -> jnp.ndarray:
+    """Exact attention over a sequence sharded on ``axis``.
+
+    q/k/v: [B, S, H, D] GLOBAL arrays (sharded on S over ``axis``).
+    Returns [B, S, H, D] with the same sharding.  N = axis size ring
+    steps; K/V blocks travel the ring via ``ppermute`` while the local
+    block computes — the ICI-native blockwise-parallel attention.
+    """
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by ring size "
+            f"{n}; pad the sequence (uneven blocks would silently "
+            f"misalign ring positions)")
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def local(qb, kb, vb):
+        # qb/kb/vb: [B, S/n, H, D] local blocks
+        idx = jax.lax.axis_index(axis)
+        b, sq, h, d = qb.shape
+
+        m0 = jnp.full((b, h, sq), -jnp.inf, qb.dtype)
+        l0 = jnp.zeros((b, h, sq), qb.dtype)
+        o0 = jnp.zeros_like(qb)
+
+        def step(carry, i):
+            m, l, o, kb_i, vb_i = carry
+            # which global block currently resides here: the block that
+            # started at (idx - i) mod n
+            src = (idx - i) % n
+            mask = None
+            if causal:
+                # query global positions: idx*sq + [0, sq); key
+                # positions: src*sq + [0, sq)
+                qpos = idx * sq + jax.lax.broadcasted_iota(
+                    jnp.int32, (sq, sq), 0)
+                kpos = src * sq + jax.lax.broadcasted_iota(
+                    jnp.int32, (sq, sq), 1)
+                mask = (qpos >= kpos)[None]          # [1, Sq, Sk]
+
+            # vmap over batch
+            m, l, o = jax.vmap(
+                lambda qi, ki, vi, mi, li, oi: _local_flash_block(
+                    qi, ki, vi, mi, li, oi, scale, mask)
+            )(qb, kb_i, vb_i, m, l, o)
+            # rotate K/V to the next ring neighbor
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb_n = jax.lax.ppermute(kb_i, axis, perm)
+            vb_n = jax.lax.ppermute(vb_i, axis, perm)
+            return (m, l, o, kb_n, vb_n), None
+
+        (m, l, o, _, _), _ = jax.lax.scan(
+            step, (m0, l0, o0, kb, vb), jnp.arange(n))
+        denom = jnp.where(l > 0, l, 1.0)             # [B, H, Sq]
+        return o / denom.transpose(0, 2, 1)[..., None]
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis), check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, axis: str = "data",
+                      causal: bool = False) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses form).
+
+    q/k/v: [B, S, H, D] sharded on S over ``axis``; H must divide by the
+    axis size.  all_to_all converts S-sharding → H-sharding, each chip
+    runs full-sequence attention on its heads, and the inverse
+    all_to_all restores S-sharding.
+    """
+    n = mesh.shape[axis]
+    if q.shape[2] % n:
+        raise ValueError(f"heads={q.shape[2]} not divisible by "
+                         f"axis size {n}")
+    if q.shape[1] % n:
+        raise ValueError(f"sequence length {q.shape[1]} not divisible "
+                         f"by axis size {n}; pad the sequence")
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def local(qb, kb, vb):
+        # [B, S/n, H, D] → [B, S, H/n, D]
+        def s2h(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        def h2s(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        qf, kf, vf = s2h(qb), s2h(kb), s2h(vb)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+        if causal:
+            sq = s.shape[-2]
+            qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+            kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+            s = jnp.where((qpos >= kpos)[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        of = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+        return h2s(of)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis), check_vma=False)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Single-device exact attention for testing parity."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = s.shape[-2:]
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

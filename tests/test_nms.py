@@ -85,3 +85,39 @@ def test_class_aware_nms_keeps_cross_class_overlaps():
     _, _, valid_same = class_aware_nms(boxes, scores, 0.5, 2,
                                        class_ids=jnp.asarray([1, 1]))
     assert np.asarray(valid_same).sum() == 1
+
+
+def test_fixed_point_equals_sequential_greedy():
+    """The while-loop fixed point must reproduce exact greedy NMS,
+    including multi-level suppression chains (A kills B, so B cannot
+    kill C)."""
+    from eksml_tpu.ops.nms import nms_mask, nms_mask_sequential
+
+    rng = np.random.RandomState(0)
+    for trial in range(8):
+        n = 64
+        ctr = rng.rand(n, 2) * 60
+        wh = rng.rand(n, 2) * 30 + 5
+        boxes = jnp.asarray(np.concatenate([ctr, ctr + wh], 1)
+                            .astype(np.float32))
+        scores = jnp.asarray(rng.rand(n).astype(np.float32))
+        # add padding rows
+        boxes = jnp.concatenate([boxes, jnp.zeros((8, 4))])
+        scores = jnp.concatenate([scores, jnp.full((8,), -jnp.inf)])
+        a = np.asarray(nms_mask(boxes, scores, 0.5))
+        b = np.asarray(nms_mask_sequential(boxes, scores, 0.5))
+        np.testing.assert_array_equal(a, b, err_msg=f"trial {trial}")
+
+
+def test_fixed_point_chain():
+    # hand-built chain: A(0.9) suppresses B(0.8); B would suppress
+    # C(0.7) but is dead, so C survives
+    boxes = jnp.asarray([[0, 0, 10, 10],
+                         [0, 0, 10, 8],      # IoU(A,B)=0.8
+                         [0, 6.5, 10, 14]],  # IoU(B,C)~0.51, IoU(A,C)~0.27
+                        jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    from eksml_tpu.ops.nms import nms_mask
+
+    keep = np.asarray(nms_mask(boxes, scores, 0.5))
+    assert keep.tolist() == [True, False, True]
