@@ -141,3 +141,45 @@ def test_predict_shapes_and_validity():
     # boxes are clipped to the image
     bx = np.asarray(out["boxes"])
     assert bx.min() >= 0 and bx.max() <= 128
+
+
+@pytest.mark.slow
+def test_gn_and_bf16_variants(fresh_config):
+    """The two advertised model variants off the default path: GroupNorm
+    backbone (BACKBONE.NORM=GN) and bfloat16 compute (the optimized
+    chart's TENSORPACK_FP16 analogue) both produce finite losses."""
+    import jax
+    import jax.numpy as jnp
+    from eksml_tpu.data.loader import make_synthetic_batch
+    from eksml_tpu.models import MaskRCNN
+
+    cfg = fresh_config
+    cfg.PREPROC.MAX_SIZE = 128
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (128, 128)
+    cfg.DATA.MAX_GT_BOXES = 8
+    cfg.RPN.TRAIN_PRE_NMS_TOPK = 64
+    cfg.RPN.TRAIN_POST_NMS_TOPK = 32
+    cfg.FRCNN.BATCH_PER_IM = 16
+    cfg.FPN.NUM_CHANNEL = 32
+    cfg.FPN.FRCNN_FC_HEAD_DIM = 64
+    cfg.MRCNN.HEAD_DIM = 16
+    cfg.BACKBONE.RESNET_NUM_BLOCKS = (1, 1, 1, 1)
+    cfg.BACKBONE.NORM = "GN"
+    cfg.TRAIN.PRECISION = "bfloat16"
+    cfg.freeze()
+
+    model = MaskRCNN.from_config(cfg)
+    assert model.compute_dtype == jnp.bfloat16
+    batch = make_synthetic_batch(cfg, 1, 128, gt_mask_size=28)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng, batch, rng)["params"]
+    # GN: GroupNorm params present, no FrozenBN
+    stem_keys = set(params["backbone"].keys())
+    assert any(k.startswith("GroupNorm") for k in stem_keys), stem_keys
+    losses = jax.jit(lambda p, b, r: model.apply({"params": p}, b, r))(
+        params, batch, rng)
+    assert all(np.isfinite(float(v)) for v in losses.values()), losses
+    # losses stay f32 even under bf16 compute
+    assert losses["total_loss"].dtype == jnp.float32
