@@ -45,6 +45,8 @@ def main(argv=None):
     p.add_argument("--image-size", type=int, default=1024)
     p.add_argument("--precision", default="bfloat16",
                    choices=["bfloat16", "float32"])
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize backbone/FPN (TRAIN.REMAT)")
     p.add_argument("--config", nargs="*", default=[],
                    help="KEY=VALUE overrides")
     args = p.parse_args(argv)
@@ -61,6 +63,7 @@ def main(argv=None):
 
     cfg.freeze(False)
     cfg.TRAIN.PRECISION = args.precision
+    cfg.TRAIN.REMAT = args.remat
     cfg.TRAIN.BATCH_SIZE_PER_CHIP = args.batch_size
     cfg.PREPROC.MAX_SIZE = args.image_size
     cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (args.image_size, args.image_size)

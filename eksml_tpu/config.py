@@ -155,6 +155,9 @@ def _define_defaults() -> None:
     _C.DATA.NUM_CLASSES = 81       # 80 COCO categories + background
     _C.DATA.MAX_GT_BOXES = 100     # static padding for ragged GT
     _C.DATA.SYNTHETIC = False      # tests/bench: generated data, no disk
+    # decode/augment worker threads per host (≙ TensorPack's
+    # multiprocess dataflow prefetch); 0 = inline in the producer
+    _C.DATA.NUM_WORKERS = 8
 
     # ---- preprocessing (static shapes are load-bearing on TPU) ------
     _C.PREPROC.TRAIN_SHORT_EDGE_SIZE = (800, 800)
@@ -231,6 +234,10 @@ def _define_defaults() -> None:
     _C.TRAIN.SYNC_CHECK_PERIOD = 0
     _C.TRAIN.SEED = 0
     _C.TRAIN.PRECISION = "float32" # "bfloat16" ≙ TENSORPACK_FP16/--fp16
+    # rematerialize backbone+FPN activations in the backward pass —
+    # trades FLOPs for HBM, the lever that buys batch-4/chip at 1344px
+    # (no reference equivalent; V100s just had the memory)
+    _C.TRAIN.REMAT = False
     _C.TRAIN.LOGDIR = "/tmp/eksml_tpu/train_log/maskrcnn"
 
     # ---- TPU / comm layer (≙ HOROVOD_*/NCCL_* env, values.yaml:24-28)

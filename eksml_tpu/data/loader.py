@@ -98,7 +98,8 @@ class DetectionLoader:
                  is_training: bool = True, num_hosts: int = 1,
                  host_id: int = 0, seed: int = 0,
                  with_masks: bool = True, prefetch: int = 4,
-                 gt_mask_size: int = 56):
+                 gt_mask_size: int = 56,
+                 num_workers: Optional[int] = None):
         assert len(records) > 0, "empty dataset"
         self.records = records[host_id::num_hosts]
         if not self.records:  # more hosts than records (tiny smoke runs)
@@ -113,12 +114,25 @@ class DetectionLoader:
         self.mean = np.asarray(cfg.PREPROC.PIXEL_MEAN, np.float32)
         self.std = np.asarray(cfg.PREPROC.PIXEL_STD, np.float32)
         self.max_gt = cfg.DATA.MAX_GT_BOXES
+        if num_workers is None:
+            num_workers = getattr(cfg.DATA, "NUM_WORKERS", 0)
+        self.num_workers = num_workers
         self._order = np.arange(len(self.records))
         self._pos = 0
 
     # -- single example -----------------------------------------------
 
-    def _load_example(self, rec: Dict) -> Dict[str, np.ndarray]:
+    def _draw(self):
+        """Per-example random decisions, drawn in the producer thread so
+        worker-pool decoding stays deterministic and thread-safe."""
+        short_edges = self.cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE \
+            if self.is_training else (self.cfg.PREPROC.TEST_SHORT_EDGE_SIZE,) * 2
+        short = int(self.rng.randint(min(short_edges), max(short_edges) + 1))
+        do_flip = self.is_training and bool(self.rng.rand() < 0.5)
+        return short, do_flip
+
+    def _load_example(self, rec: Dict, short: int,
+                      do_flip: bool) -> Dict[str, np.ndarray]:
         if rec.get("_image") is not None:
             image = rec["_image"]
         else:
@@ -134,14 +148,11 @@ class DetectionLoader:
         boxes, classes, crowd = boxes[order], classes[order], crowd[order]
         segs = [rec["segmentation"][i] for i in order]
 
-        short_edges = self.cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE \
-            if self.is_training else (self.cfg.PREPROC.TEST_SHORT_EDGE_SIZE,) * 2
-        short = int(self.rng.randint(min(short_edges), max(short_edges) + 1))
         max_size = self.cfg.PREPROC.MAX_SIZE
         image_f, scale, (nh, nw) = resize_and_pad(image, short, max_size)
         boxes = boxes * scale
 
-        if self.is_training and self.rng.rand() < 0.5:
+        if do_flip:
             image_f[:, :nw] = image_f[:, :nw][:, ::-1]
             x1 = nw - boxes[:, 2]
             x2 = nw - boxes[:, 0]
@@ -240,13 +251,28 @@ class DetectionLoader:
 
         error = []
 
+        pool = None
+        if self.num_workers and self.num_workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(max_workers=self.num_workers,
+                                      thread_name_prefix="decode")
+
         def producer():
             produced = 0
             try:
                 while not stop.is_set() and (num_steps is None
                                              or produced < num_steps):
                     idx = self._next_indices()
-                    exs = [self._load_example(self.records[i]) for i in idx]
+                    recs = [self.records[i] for i in idx]
+                    draws = [self._draw() for _ in idx]
+                    if pool is not None:
+                        exs = list(pool.map(
+                            self._load_example, recs,
+                            [d[0] for d in draws], [d[1] for d in draws]))
+                    else:
+                        exs = [self._load_example(r, s, f)
+                               for r, (s, f) in zip(recs, draws)]
                     batch = {k: np.stack([e[k] for e in exs])
                              for k in exs[0].keys()}
                     if not put_or_stop(batch):
@@ -270,6 +296,8 @@ class DetectionLoader:
         finally:
             stop.set()
             t.join(timeout=5.0)
+            if pool is not None:
+                pool.shutdown(wait=False)
 
 
 def _crop_resize_binary(mask: np.ndarray, box, out_size: int) -> np.ndarray:

@@ -69,6 +69,9 @@ class MaskRCNN(nn.Module):
     test_score_thresh: float = 0.05
     test_results_per_im: int = 100
     compute_dtype: Any = jnp.float32
+    # remat backbone/FPN activations (TRAIN.REMAT): recomputed in the
+    # backward pass, freeing the largest activation tensors from HBM
+    remat: bool = False
     # Cascade R-CNN (BASELINE configs[4]; models/cascade.py)
     cascade: bool = False
     cascade_ious: Tuple[float, ...] = (0.5, 0.6, 0.7)
@@ -108,6 +111,7 @@ class MaskRCNN(nn.Module):
             test_results_per_im=cfg.TEST.RESULTS_PER_IM,
             compute_dtype=(jnp.bfloat16 if cfg.TRAIN.PRECISION == "bfloat16"
                            else jnp.float32),
+            remat=cfg.TRAIN.REMAT,
             cascade=cfg.MODE_CASCADE,
             cascade_ious=tuple(cfg.CASCADE.IOUS),
             cascade_reg_weights=tuple(
@@ -115,11 +119,13 @@ class MaskRCNN(nn.Module):
         )
 
     def setup(self):
-        self.backbone = ResNetBackbone(num_blocks=self.resnet_blocks,
-                                       norm=self.norm,
-                                       freeze_at=self.freeze_at,
-                                       name="backbone")
-        self.fpn = FPN(num_channels=self.fpn_channels, name="fpn")
+        bb_cls = nn.remat(ResNetBackbone) if self.remat else ResNetBackbone
+        fpn_cls = nn.remat(FPN) if self.remat else FPN
+        self.backbone = bb_cls(num_blocks=self.resnet_blocks,
+                               norm=self.norm,
+                               freeze_at=self.freeze_at,
+                               name="backbone")
+        self.fpn = fpn_cls(num_channels=self.fpn_channels, name="fpn")
         self.rpn_head = RPNHead(num_anchors=len(self.anchor_ratios),
                                 channels=self.fpn_channels, name="rpn")
         if self.cascade:

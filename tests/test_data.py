@@ -158,3 +158,23 @@ def test_make_synthetic_batch(fresh_config):
     assert b["gt_masks"].shape[2:] == (28, 28)
     # config restored
     assert fresh_config.PREPROC.MAX_SIZE == 1344
+
+
+def test_loader_worker_pool_determinism(fresh_config):
+    """Decoding through the worker pool must produce byte-identical
+    batches to inline decoding (randomness is drawn in the producer,
+    not the workers)."""
+    from eksml_tpu.data.loader import DetectionLoader, SyntheticDataset
+
+    cfg = fresh_config
+    cfg.PREPROC.MAX_SIZE = 64
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (48, 64)
+    cfg.DATA.MAX_GT_BOXES = 8
+    ds = SyntheticDataset(num_images=8, height=64, width=64)
+    a = DetectionLoader(ds.records(), cfg, 4, seed=3, num_workers=0,
+                        gt_mask_size=28)
+    b = DetectionLoader(ds.records(), cfg, 4, seed=3, num_workers=4,
+                        gt_mask_size=28)
+    for ba, bb in zip(a.batches(3), b.batches(3)):
+        for k in ba:
+            np.testing.assert_array_equal(ba[k], bb[k], err_msg=k)
