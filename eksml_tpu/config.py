@@ -210,6 +210,10 @@ def _define_defaults() -> None:
     _C.TEST.FRCNN_NMS_THRESH = 0.5
     _C.TEST.RESULT_SCORE_THRESH = 0.05
     _C.TEST.RESULTS_PER_IM = 100
+    # images per jitted predict call during periodic eval; the
+    # reference's single-rank eval is effectively batch 1 — batching is
+    # required to keep EVAL_PERIOD=1 epochs from dominating wall-clock
+    _C.TEST.EVAL_BATCH_SIZE = 4
 
     # ---- training schedule (reference values.yaml:14-16,29) ---------
     _C.TRAIN.NUM_CHIPS = 1         # ≙ gpus in values.yaml:8

@@ -12,17 +12,23 @@ on a gather path the TPU executes poorly.  This kernel:
   (row weights) and ``Cx`` likewise for columns — exactly the 2-tap
   bilinear weights, built with iota arithmetic on the VPU;
 - DMAs one fixed ``T×T×C`` feature tile per ROI from HBM (grid is
-  sequential per core, so no write races), scalar-prefetching the
-  level/batch/origin indices.
+  sequential per core, so no write races), scalar-prefetching ALL
+  per-ROI metadata — level/batch/origin indices and the float
+  start/bin-size values — through SMEM.  (Putting the float info in a
+  VMEM block would need a (1, 8) block shape, which Mosaic rejects:
+  the second-to-last block dim must be a multiple of 8.)
 
 Semantics notes:
 - matches ``aligned=True`` ROIAlign with zero padding outside the
   image, PROVIDED each level's feature map is spatially padded to at
   least ``T`` (the caller pads; padding is zeros, which is exactly the
   zero-padding ROIAlign wants);
-- ROIs whose extent at their assigned level exceeds ``T - 2`` pixels
-  are truncated to the tile (only pathological aspect ratios; the FPN
-  level heuristic bounds √area/stride ≤ ~56).
+- level assignment is the shared tile-fit variant
+  (``assign_fpn_levels_tile_fit``): ROIs whose extent would overflow
+  the tile at the heuristic level are bumped to a coarser level, so
+  the forward kernel and the XLA backward (which receives the SAME
+  levels) compute identical values — no silent fwd/bwd divergence for
+  extreme aspect ratios.
 
 The backward pass reuses the XLA formulation via ``jax.custom_vjp``
 (gather-grads become scatter-adds XLA already emits well); making the
@@ -33,30 +39,70 @@ need.
 from __future__ import annotations
 
 import functools
+import logging
+import os
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+log = logging.getLogger(__name__)
+
 TILE = 64  # T: per-ROI feature tile (covers √area/stride ≲ 56 + taps)
 
+_PROBE_RESULT = None  # cached hardware compile-probe outcome
 
-def pallas_roi_align_supported() -> bool:
-    """Kernel path is for real TPU backends; everything else falls
-    back to XLA (tests exercise the kernel via interpret=True)."""
+
+def _probe_compile() -> bool:
+    """Compile + run the kernel once on tiny real shapes.  The Mosaic
+    compiler is versioned independently of jax; a kernel that lowers in
+    interpret mode can still be rejected on hardware (round 1: the
+    whole training path died at bench time).  One cheap probe decides
+    the dispatch instead."""
     try:
-        return jax.default_backend() == "tpu"
-    except Exception:
+        # production shape class: 4 FPN levels, C=256 (fpn.py) — the
+        # multi-level @pl.when DMA selection and full scratch size must
+        # compile, not just a toy single-level variant
+        feats = tuple(jnp.zeros((1, max(TILE, 256 // s), max(TILE, 256 // s),
+                                 256), jnp.float32) for s in (4, 8, 16, 32))
+        rois = jnp.asarray([[[4.0, 4.0, 36.0, 36.0],
+                             [8.0, 8.0, 200.0, 120.0]]], jnp.float32)
+        out = pallas_batched_multilevel_roi_align(
+            feats, rois, (4, 8, 16, 32), 7, 2, 2)
+        jax.block_until_ready(out)
+        return bool(np.isfinite(np.asarray(out)).all())
+    except Exception as e:  # noqa: BLE001 — any compile/runtime failure
+        log.warning("Pallas ROIAlign unavailable on this backend "
+                    "(falling back to XLA): %s", e)
         return False
 
 
+def pallas_roi_align_supported() -> bool:
+    """True when the kernel path should be used: real TPU backend AND
+    the kernel compiles there (probed once, cached).  Overridable via
+    ``EKSML_ROI_BACKEND={auto,pallas,xla}`` — the A/B switch bench.py
+    exposes as ``--roi-backend``."""
+    global _PROBE_RESULT
+    mode = os.environ.get("EKSML_ROI_BACKEND", "auto").lower()
+    if mode == "xla":
+        return False
+    if mode == "pallas":
+        return True
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    if _PROBE_RESULT is None:
+        _PROBE_RESULT = _probe_compile()
+    return _PROBE_RESULT
+
+
 def _kernel(out_size: int, sampling: int, num_levels: int,
-            # scalar prefetch
-            lvl_ref, b_ref, y0_ref, x0_ref,
-            # VMEM per-roi float info [1, 8]:
-            # (y_start, x_start, bin_h, bin_w, 0, 0, 0, 0) tile-local
-            info_ref,
+            # scalar prefetch (SMEM), one entry per ROI:
+            lvl_ref, b_ref, y0_ref, x0_ref,   # int32 level/batch/origin
+            ys_ref, xs_ref, bh_ref, bw_ref,   # f32 tile-local start/bin
             *refs):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -70,7 +116,10 @@ def _kernel(out_size: int, sampling: int, num_levels: int,
     lvl = lvl_ref[r]
     b = b_ref[r]
     y0 = y0_ref[r]
-    x0 = x0_ref[r]
+    # x0 arrives as a sublane-block count; multiplying by 8 here lets
+    # Mosaic PROVE the W-dim slice origin is 8-aligned (its HBM-slice
+    # tiling requirement — an SMEM value alone is unprovable)
+    x0 = x0_ref[r] * 8
 
     for i in range(num_levels):
         @pl.when(lvl == i)
@@ -81,10 +130,10 @@ def _kernel(out_size: int, sampling: int, num_levels: int,
             dma.start()
             dma.wait()
 
-    y_start = info_ref[0, 0]
-    x_start = info_ref[0, 1]
-    bin_h = info_ref[0, 2]
-    bin_w = info_ref[0, 3]
+    y_start = ys_ref[r]
+    x_start = xs_ref[r]
+    bin_h = bh_ref[r]
+    bin_w = bw_ref[r]
 
     s_total = out_size * sampling
     f32 = jnp.float32
@@ -92,8 +141,11 @@ def _kernel(out_size: int, sampling: int, num_levels: int,
     def weights(start, binsz):
         """[S, T] two-tap bilinear weight matrix for sample coords
         start + (bin + (j+0.5)/sampling) * binsz."""
-        s_idx = jax.lax.broadcasted_iota(f32, (s_total, TILE), 0)
-        t_idx = jax.lax.broadcasted_iota(f32, (s_total, TILE), 1)
+        # Mosaic's iota is integer-only; build int32 and convert
+        s_idx = jax.lax.broadcasted_iota(
+            jnp.int32, (s_total, TILE), 0).astype(f32)
+        t_idx = jax.lax.broadcasted_iota(
+            jnp.int32, (s_total, TILE), 1).astype(f32)
         bins = jnp.floor(s_idx / sampling)
         off = (s_idx - bins * sampling + 0.5) / sampling
         coord = start + (bins + off) * binsz
@@ -104,14 +156,19 @@ def _kernel(out_size: int, sampling: int, num_levels: int,
 
     tile = tile_ref[:].astype(f32)                  # [T, T, C]
     c = tile.shape[-1]
-    # rows: [S, T] @ [T, T*C] → [S, T, C]
+    # rows: [S, T] @ [T, T*C] → [S, T, C].  HIGHEST precision: the MXU
+    # multiplies in bf16 passes; one-pass (default) loses ~2^-8 relative
+    # accuracy vs the XLA gather formulation.
     rows = jnp.dot(ry, tile.reshape(TILE, TILE * c),
-                   preferred_element_type=f32).reshape(s_total, TILE, c)
+                   preferred_element_type=f32,
+                   precision=jax.lax.Precision.HIGHEST
+                   ).reshape(s_total, TILE, c)
     # cols: contract T with cx → [S, S, C]
     sampled = jax.lax.dot_general(
         rows, cx.T,
         dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=f32)                 # [S, C, S]
+        preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST)        # [S, C, S]
     sampled = sampled.transpose(0, 2, 1)            # [S, S, C]
     pooled = sampled.reshape(out_size, sampling, out_size, sampling,
                              c).mean(axis=(1, 3))
@@ -119,15 +176,14 @@ def _kernel(out_size: int, sampling: int, num_levels: int,
 
 
 def _prep(feats, rois, strides, out_size, min_level):
-    """Host-side (traced) index/weight prep: level assignment, clamped
-    tile origins, tile-local sample-start coordinates."""
-    from eksml_tpu.ops.roi_align import assign_fpn_levels
+    """Host-side (traced) index/weight prep: tile-fit level assignment,
+    clamped tile origins, tile-local sample-start coordinates."""
+    from eksml_tpu.ops.roi_align import assign_fpn_levels_tile_fit
 
     b, n = rois.shape[0], rois.shape[1]
     flat = rois.reshape(b * n, 4)
-    levels = assign_fpn_levels(
-        flat, min_level=min_level,
-        max_level=min_level + len(feats) - 1) - min_level   # [BN] in [0,L)
+    levels = assign_fpn_levels_tile_fit(
+        flat, strides, len(feats), TILE, min_level=min_level)  # [BN] in [0,L)
     batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.int32), n)
 
     inv_strides = jnp.asarray([1.0 / s for s in strides], jnp.float32)
@@ -141,28 +197,32 @@ def _prep(feats, rois, strides, out_size, min_level):
 
     h_pad = jnp.asarray([f.shape[1] for f in feats], jnp.int32)[levels]
     w_pad = jnp.asarray([f.shape[2] for f in feats], jnp.int32)[levels]
-    # aligned=True: samples start at y1 - 0.5; tile origin 1 tap early
+    # aligned=True: samples start at y1 - 0.5; tile origin 1 tap early.
+    # The x origin is additionally rounded DOWN to a multiple of 8 and
+    # shipped as a block count (Mosaic requires provable 8-alignment of
+    # the W-dim HBM slice; _pad_levels makes w_pad ≡ 0 mod 8 so the
+    # clamp bound is itself aligned and right-edge coverage survives).
     y0 = jnp.clip(jnp.floor(y1 - 1.5).astype(jnp.int32), 0,
                   jnp.maximum(h_pad - TILE, 0))
     x0 = jnp.clip(jnp.floor(x1 - 1.5).astype(jnp.int32), 0,
-                  jnp.maximum(w_pad - TILE, 0))
+                  jnp.maximum(w_pad - TILE, 0)) // 8 * 8
 
-    info = jnp.stack([
-        y1 - 0.5 + 0.0 - y0.astype(jnp.float32),
-        x1 - 0.5 + 0.0 - x0.astype(jnp.float32),
-        bin_h, bin_w,
-        jnp.zeros_like(bin_h), jnp.zeros_like(bin_h),
-        jnp.zeros_like(bin_h), jnp.zeros_like(bin_h)], axis=-1)
-    return levels.astype(jnp.int32), batch_idx, y0, x0, info
+    ys = y1 - 0.5 - y0.astype(jnp.float32)
+    xs = x1 - 0.5 - x0.astype(jnp.float32)
+    return (levels.astype(jnp.int32), batch_idx, y0, x0 // 8,
+            ys, xs, bin_h, bin_w)
 
 
 def _pad_levels(feats):
-    """Zero-pad each level's spatial dims to ≥ TILE (zero padding IS
-    ROIAlign's out-of-image semantics, so this is free correctness)."""
+    """Zero-pad each level's spatial dims to ≥ TILE, and W additionally
+    to a multiple of 8 so the clamped tile x-origin stays sublane-
+    aligned (zero padding IS ROIAlign's out-of-image semantics, so this
+    is free correctness)."""
     out = []
     for f in feats:
         _, h, w, _ = f.shape
-        ph, pw = max(TILE - h, 0), max(TILE - w, 0)
+        ph = max(TILE - h, 0)
+        pw = max(TILE - w, 0) or (-w % 8)
         if ph or pw:
             f = jnp.pad(f, ((0, 0), (0, ph), (0, pw), (0, 0)))
         out.append(f)
@@ -177,18 +237,14 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
     feats = _pad_levels(feats)
     b, n = rois.shape[0], rois.shape[1]
     c = feats[0].shape[-1]
-    levels, batch_idx, y0, x0, info = _prep(feats, rois, strides,
-                                            out_size, min_level)
+    scalars = _prep(feats, rois, strides, out_size, min_level)
     num_levels = len(feats)
     kern = functools.partial(_kernel, out_size, sampling, num_levels)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=8,
         grid=(b * n,),
-        in_specs=[
-            pl.BlockSpec((1, 8), lambda r, *_: (r, 0),
-                         memory_space=pltpu.VMEM),
-        ] + [pl.BlockSpec(memory_space=pltpu.ANY)] * num_levels,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
         out_specs=pl.BlockSpec((1, out_size, out_size, c),
                                lambda r, *_: (r, 0, 0, 0),
                                memory_space=pltpu.VMEM),
@@ -203,7 +259,7 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
         out_shape=jax.ShapeDtypeStruct((b * n, out_size, out_size, c),
                                        feats[0].dtype),
         interpret=interpret,
-    )(levels, batch_idx, y0, x0, info, *feats)
+    )(*scalars, *feats)
     return out.reshape(b, n, out_size, out_size, c)
 
 
@@ -227,14 +283,21 @@ def _fwd(feats, rois, strides, out_size, sampling_ratio, min_level,
 
 
 def _bwd(strides, out_size, sampling_ratio, min_level, interpret, res, g):
-    """Backward through the XLA formulation (identical math up to the
-    tile-truncation edge case); scatter-add grads XLA handles well."""
-    from eksml_tpu.ops.roi_align import batched_multilevel_roi_align
+    """Backward through the XLA formulation with the SAME tile-fit level
+    assignment as the forward kernel (identical math; scatter-add grads
+    XLA handles well)."""
+    from eksml_tpu.ops.roi_align import (assign_fpn_levels_tile_fit,
+                                         batched_multilevel_roi_align)
 
     feats, rois = res
+    b, n = rois.shape[0], rois.shape[1]
+    levels = assign_fpn_levels_tile_fit(
+        rois.reshape(b * n, 4), strides, len(feats), TILE,
+        min_level=min_level).reshape(b, n)
     _, vjp = jax.vjp(
         lambda fs: batched_multilevel_roi_align(
-            fs, rois, strides, out_size, sampling_ratio, min_level),
+            fs, rois, strides, out_size, sampling_ratio, min_level,
+            levels=levels),
         feats)
     (g_feats,) = vjp(g)
     return g_feats, jnp.zeros_like(rois)

@@ -91,22 +91,52 @@ def assign_fpn_levels(rois: jnp.ndarray, min_level: int = 2,
     return jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
 
 
+def assign_fpn_levels_tile_fit(rois: jnp.ndarray, strides: Sequence[int],
+                               num_levels: int, tile: int,
+                               min_level: int = 2) -> jnp.ndarray:
+    """Level *indices* (``[N]`` in ``[0, num_levels)``) for the Pallas
+    tile kernel: the FPN heuristic, bumped to a coarser level whenever
+    the ROI's extent at the assigned level would not fit in a
+    ``tile × tile`` feature window (extreme aspect ratios).  Forward
+    kernel and XLA backward both use this assignment so their values
+    agree exactly.  Assumes FPN's ``strides[l] = strides[0] · 2^l``."""
+    levels = assign_fpn_levels(
+        rois, min_level=min_level,
+        max_level=min_level + num_levels - 1) - min_level
+    w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    extent = jnp.maximum(jnp.maximum(w, h), 1e-4)
+    # need extent/strides[l] ≤ tile-11: 2 bilinear taps + origin slack
+    # + up to 7 px of sublane alignment (the kernel's tile x-origin is
+    # rounded down to a multiple of 8 — Mosaic's HBM slice constraint)
+    need = jnp.ceil(jnp.log2(extent / ((tile - 11.0) * strides[0])))
+    levels = jnp.maximum(levels, need.astype(jnp.int32))
+    return jnp.clip(levels, 0, num_levels - 1)
+
+
 def multilevel_roi_align(feats: Sequence[jnp.ndarray], rois: jnp.ndarray,
                          strides: Sequence[int], out_size: int,
                          sampling_ratio: int = 2,
-                         min_level: int = 2) -> jnp.ndarray:
+                         min_level: int = 2,
+                         levels: jnp.ndarray | None = None) -> jnp.ndarray:
     """FPN ROIAlign: feats ``[(Hl, Wl, C), ...]`` for levels
     P_min..P_max, rois ``[N, 4]`` → ``[N, out, out, C]``.
 
     Static-shape strategy: align every ROI on every level, then select
     by one-hot level mask.  XLA fuses the weighted sum; the redundant
     levels are the price of shape stability (Pallas kernel removes it).
+
+    ``levels``: optional explicit per-ROI level indices in
+    ``[0, len(feats))`` — used by the Pallas backward so both passes
+    share one assignment.
     """
-    levels = assign_fpn_levels(rois, min_level=min_level,
-                               max_level=min_level + len(feats) - 1)
+    if levels is None:
+        levels = assign_fpn_levels(
+            rois, min_level=min_level,
+            max_level=min_level + len(feats) - 1) - min_level
     out = None
     for i, (feat, stride) in enumerate(zip(feats, strides)):
-        mask = (levels == (min_level + i)).astype(feat.dtype)
+        mask = (levels == i).astype(feat.dtype)
         aligned = roi_align(feat, rois, 1.0 / stride, out_size, sampling_ratio)
         contrib = aligned * mask[:, None, None, None]
         out = contrib if out is None else out + contrib
@@ -114,13 +144,21 @@ def multilevel_roi_align(feats: Sequence[jnp.ndarray], rois: jnp.ndarray,
 
 
 def batched_multilevel_roi_align(feats, rois, strides, out_size,
-                                 sampling_ratio: int = 2, min_level: int = 2):
+                                 sampling_ratio: int = 2, min_level: int = 2,
+                                 levels=None):
     """vmap over batch: feats ``[(B, Hl, Wl, C), ...]``, rois ``[B, N, 4]``."""
+    if levels is None:
+        fn = jax.vmap(
+            lambda fs, r: multilevel_roi_align(fs, r, strides, out_size,
+                                               sampling_ratio, min_level),
+            in_axes=(0, 0))
+        return fn(tuple(feats), rois)
     fn = jax.vmap(
-        lambda fs, r: multilevel_roi_align(fs, r, strides, out_size,
-                                           sampling_ratio, min_level),
-        in_axes=(0, 0))
-    return fn(tuple(feats), rois)
+        lambda fs, r, lv: multilevel_roi_align(fs, r, strides, out_size,
+                                               sampling_ratio, min_level,
+                                               levels=lv),
+        in_axes=(0, 0, 0))
+    return fn(tuple(feats), rois, levels)
 
 
 def dispatch_roi_align(feats, rois, strides, out_size,

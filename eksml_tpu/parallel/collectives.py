@@ -21,15 +21,42 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def set_xla_collective_flags(combine_threshold_bytes: int) -> None:
+def set_xla_collective_flags(combine_threshold_bytes: int,
+                             validate: bool = True) -> None:
     """HOROVOD_FUSION_THRESHOLD analogue: how many bytes of gradient
     all-reduce XLA combines into one collective.  Must run before the
-    backend compiles the train step."""
+    backend compiles the train step.
+
+    The flag is VALIDATED with a throwaway compile when a TPU backend
+    is live: libtpu forwards ``LIBTPU_INIT_ARGS`` xla_* entries as
+    per-compile options, and a libtpu whose XLA revision doesn't know
+    the option rejects EVERY subsequent compile (observed on the v5e
+    tunnel this repo benches on).  A tuning knob must degrade to a
+    warning, not take down training."""
     flags = os.environ.get("LIBTPU_INIT_ARGS", "")
-    add = (f" --xla_tpu_all_reduce_combine_threshold_bytes="
-           f"{combine_threshold_bytes}")
     if "all_reduce_combine_threshold" not in flags:
-        os.environ["LIBTPU_INIT_ARGS"] = (flags + add).strip()
+        os.environ["LIBTPU_INIT_ARGS"] = (
+            f"{flags} --xla_tpu_all_reduce_combine_threshold_bytes="
+            f"{combine_threshold_bytes}").strip()
+    if not validate:
+        return
+    try:
+        if jax.default_backend() != "tpu":
+            return
+        # unique constant → cache miss → exercises a real compile with
+        # the flag in effect (covers a chart-injected env value too)
+        probe = jax.jit(lambda x: x * np.float32(combine_threshold_bytes
+                                                 % 1009 + 2))
+        jax.block_until_ready(probe(jnp.ones((8,), jnp.float32)))
+    except Exception as e:  # noqa: BLE001 — any backend/compile failure
+        os.environ["LIBTPU_INIT_ARGS"] = " ".join(
+            t for t in os.environ["LIBTPU_INIT_ARGS"].split()
+            if "all_reduce_combine_threshold" not in t)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "combine-threshold flag rejected by this libtpu — running "
+            "with XLA's default collective fusion (%s)", e)
 
 
 def cross_host_sum(tree):

@@ -97,8 +97,11 @@ class OfflinePredictor:
         from eksml_tpu.data.masks import paste_mask
 
         h, w = image.shape[:2]
-        im, scale, _ = self._preprocess(image)
-        hw = np.asarray([[im.shape[0], im.shape[1]]], np.float32)
+        im, scale, (nh, nw) = self._preprocess(image)
+        # Clip to the resized content extent, not the padded canvas —
+        # matches the eval path (evalcoco/runner.py) so both produce
+        # identical detections; boxes must not extend into zero padding.
+        hw = np.asarray([[nh, nw]], np.float32)
         out = self._predict(self.params, jnp.asarray(im[None]),
                             jnp.asarray(hw))
         out = jax.tree.map(np.asarray, out)

@@ -69,8 +69,12 @@ def lr_schedule(cfg) -> optax.Schedule:
     """
     global_batch = cfg.TRAIN.NUM_CHIPS * cfg.TRAIN.BATCH_SIZE_PER_CHIP
     base = cfg.TRAIN.BASE_LR * global_batch / 8.0
-    boundaries = {max(1, int(s * 8 / global_batch)): 0.1
-                  for s in cfg.TRAIN.LR_SCHEDULE}
+    # At large global batch two schedule entries can rescale onto the
+    # same step; accumulate the ×0.1 factors so no decay is dropped.
+    boundaries: Dict[int, float] = {}
+    for s in cfg.TRAIN.LR_SCHEDULE:
+        b = max(1, int(s * 8 / global_batch))
+        boundaries[b] = boundaries.get(b, 1.0) * 0.1
     main = optax.piecewise_constant_schedule(base, boundaries)
     warm = cfg.TRAIN.WARMUP_STEPS
     if warm <= 0:
@@ -237,9 +241,15 @@ class Trainer:
 
     def fit(self, batches: Iterator[Dict[str, np.ndarray]],
             total_steps: int, start_step: int = 0,
-            state: Optional[TrainState] = None) -> TrainState:
+            state: Optional[TrainState] = None,
+            profile_steps: int = 0) -> TrainState:
+        """``profile_steps``: capture a ``jax.profiler`` trace of that
+        many post-compile steps into ``<logdir>/profile`` (the
+        one-command perf-visibility path, SURVEY.md §5.1 — the
+        reference's only analogue is NCCL_DEBUG=INFO ring dumps)."""
         cfg = self.cfg
         step_fn = None
+        profile_until = None
         t_last = time.time()
         steps_per_epoch = cfg.TRAIN.STEPS_PER_EPOCH
         ckpt_every = max(1, cfg.TRAIN.CHECKPOINT_PERIOD) * steps_per_epoch
@@ -262,6 +272,21 @@ class Trainer:
             state, metrics = step_fn(state, device_batch)
             step += 1
 
+            if (profile_steps and profile_until is None
+                    and jax.process_index() == 0):
+                # first step (compile) done — trace steady-state steps
+                jax.block_until_ready(metrics["total_loss"])
+                jax.profiler.start_trace(
+                    os.path.join(self.logdir, "profile"))
+                profile_until = step + profile_steps
+            elif profile_until is not None and step >= profile_until:
+                jax.block_until_ready(metrics["total_loss"])
+                jax.profiler.stop_trace()
+                log.info("profiler trace written to %s/profile",
+                         self.logdir)
+                profile_until = None
+                profile_steps = 0
+
             if step % cfg.TRAIN.LOG_PERIOD == 0 or step == total_steps:
                 metrics = jax.tree.map(lambda x: float(np.asarray(x)),
                                        metrics)
@@ -283,12 +308,30 @@ class Trainer:
                 assert_replicas_in_sync(state.params, self.mesh)
 
             if step % ckpt_every == 0 or step == total_steps:
-                self.ckpt.save(step, jax.tree.map(np.asarray, state))
+                # hand Orbax the sharded jax arrays directly: async
+                # checkpointing snapshots to host (brief blocking D2H)
+                # and persists in a background thread.  Materializing
+                # to numpy first (round 1) forced the full write onto
+                # the step loop.  Donation is safe — the snapshot
+                # completes before save() returns.
+                t_save = time.time()
+                self.ckpt.save(step, state)
+                if self.writer:
+                    self.writer.write_scalars(step, {
+                        "checkpoint_save_ms":
+                            (time.time() - t_save) * 1000})
             if self.eval_fn and (step % eval_every == 0
                                  or step == total_steps):
                 self._run_eval(state, step)
             if step >= total_steps:
                 break
+
+        if profile_until is not None:
+            # run ended before profile_steps elapsed — close the trace
+            # so it still lands (and a later start_trace won't raise)
+            jax.profiler.stop_trace()
+            log.info("profiler trace (truncated run) written to "
+                     "%s/profile", self.logdir)
 
         self.ckpt.wait()
         if self.writer:
@@ -323,6 +366,9 @@ def parse_args(argv=None):
                    help="train on generated data (no COCO on disk)")
     p.add_argument("--total-steps", type=int, default=None,
                    help="override steps (default: epochs × steps/epoch)")
+    p.add_argument("--profile", type=int, default=0, metavar="N",
+                   help="trace N post-compile steps into "
+                        "<logdir>/profile (TensorBoard profile plugin)")
     return p.parse_args(argv)
 
 
@@ -385,7 +431,8 @@ def main(argv=None):
     total_steps = (args.total_steps if args.total_steps is not None
                    else cfg.TRAIN.STEPS_PER_EPOCH * cfg.TRAIN.MAX_EPOCHS)
 
-    trainer.fit(loader.batches(None), total_steps)
+    trainer.fit(loader.batches(None), total_steps,
+                profile_steps=args.profile)
     log.info("training complete at %d steps", total_steps)
 
 

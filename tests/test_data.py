@@ -178,3 +178,39 @@ def test_loader_worker_pool_determinism(fresh_config):
     for ba, bb in zip(a.batches(3), b.batches(3)):
         for k in ba:
             np.testing.assert_array_equal(ba[k], bb[k], err_msg=k)
+
+
+@pytest.mark.slow
+def test_loader_throughput_floor():
+    """Input-pipeline margin check (VERDICT r1 item 3): the loader must
+    sustain at least 5 images/sec/core at the 1344² operating point —
+    the old 2-D gather resize managed ~4; the separable resize ~9.
+    Real v5e hosts (~100 vCPU) scale this near-linearly, giving ample
+    margin over the ~60 img/s/host a 4-chip host needs."""
+    import os
+    import time
+
+    from eksml_tpu.config import config as cfg
+    from eksml_tpu.data import DetectionLoader, SyntheticDataset
+
+    saved = (cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE)
+    cfg.freeze(False)
+    cfg.PREPROC.MAX_SIZE = 1344
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (1000, 1024)
+    try:
+        ds = SyntheticDataset(num_images=16, height=480, width=640,
+                              num_classes=cfg.DATA.NUM_CLASSES)
+        loader = DetectionLoader(ds.records(), cfg, 8, num_workers=4)
+        it = loader.batches(6)
+        next(it)  # spin-up out of timing
+        t0 = time.time()
+        n = sum(b["images"].shape[0] for b in it)
+        # normalize by the parallelism actually available to the 4
+        # workers — on a 1-core CI box that's 1, on a v5e host it's 4
+        lanes = min(4, os.cpu_count() or 1)
+        per_lane = n / (time.time() - t0) / lanes
+        assert per_lane > 5.0, f"loader at {per_lane:.1f} img/s/lane"
+    finally:
+        cfg.freeze(False)
+        cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = saved
+        cfg.freeze()

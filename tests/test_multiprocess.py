@@ -51,6 +51,41 @@ assert abs(got - 3.5) < 1e-6, got  # mean of 0..7 — needs both hosts
 total = cross_host_sum({"loss": jnp.asarray(float(pid) + 1.0)})
 assert abs(float(total["loss"]) - 3.0) < 1e-6, total
 
+# async checkpoint round-trip of the CROSS-PROCESS sharded array: the
+# trainer now hands Orbax sharded jax arrays directly (no host numpy
+# materialization), so save→wait→restore must preserve every host's
+# shard through the async path
+from eksml_tpu.utils import CheckpointManager
+
+ckpt = CheckpointManager(os.environ["EKSML_TEST_CKPT_DIR"])
+# every leaf must be a GLOBAL array in multi-host (the trainer
+# device_puts TrainState to a replicated mesh sharding, same thing)
+step_scalar = multihost_utils.host_local_array_to_global_array(
+    np.zeros((), np.int32), mesh, jax.sharding.PartitionSpec())
+state = {"w": global_x, "step": step_scalar}
+assert ckpt.save(1, state)
+ckpt.wait()
+assert ckpt.latest_step() == 1
+restored = ckpt.restore(state)
+np.testing.assert_allclose(
+    np.asarray(restored["w"].addressable_shards[0].data),
+    np.asarray(global_x.addressable_shards[0].data))
+assert int(np.asarray(restored["step"])) == 0
+
+# eval gather protocol: variable-size, RLE-bearing detection lists
+# cross the hosts as padded byte buffers (no dense-mask gather)
+from eksml_tpu.evalcoco.runner import _gather_detection_lists
+
+mine = [{"image_id": 10 + pid,
+         "boxes": np.full((pid + 1, 4), float(pid), np.float32),
+         "scores": np.full(pid + 1, 0.5, np.float32),
+         "classes": np.zeros(pid + 1, np.int32),
+         "rles": [{"size": [4, 4], "counts": [pid, 16 - pid]}]}]
+alldets = _gather_detection_lists(mine)
+assert [d["image_id"] for d in alldets] == [10, 11], alldets
+assert alldets[1]["boxes"].shape == (2, 4)
+assert alldets[0]["rles"][0]["counts"] == [0, 16]
+
 print(f"worker {pid} OK", flush=True)
 """
 
@@ -79,6 +114,7 @@ def test_two_process_rendezvous_and_collectives(tmp_path):
             "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
             "JAX_PLATFORMS": "cpu",
             "PYTHONPATH": repo,
+            "EKSML_TEST_CKPT_DIR": str(tmp_path / "ckpt"),
         })
         procs.append(subprocess.Popen(
             [sys.executable, str(worker_py)], env=env,

@@ -28,6 +28,43 @@ def test_lr_boundaries_scale_with_global_batch(fresh_config):
     assert float(sched(160001)) == pytest.approx(base * 0.01, rel=1e-5)
 
 
+@pytest.mark.parametrize("num_chips,batch_per_chip", [
+    (8, 1),      # global batch 8  — boundaries unchanged
+    (32, 4),     # global batch 128 — v5e-32 optimized operating point
+    (256, 4),    # global batch 1024 — v5e-256 scale
+])
+def test_lr_schedule_no_dropped_decay_at_scale(fresh_config, num_chips,
+                                               batch_per_chip):
+    """At large global batch, rescaled boundaries can collide onto the
+    same step; the ×0.1 factors must accumulate, never drop.  After the
+    last boundary the LR must always be base × 0.1^len(schedule)."""
+    cfg = fresh_config
+    cfg.TRAIN.NUM_CHIPS = num_chips
+    cfg.TRAIN.BATCH_SIZE_PER_CHIP = batch_per_chip
+    cfg.TRAIN.BASE_LR = 0.01
+    cfg.TRAIN.LR_SCHEDULE = (240000, 320000, 360000)
+    cfg.TRAIN.WARMUP_STEPS = 0
+    global_batch = num_chips * batch_per_chip
+    sched = lr_schedule(cfg)
+    base = 0.01 * global_batch / 8
+    last = max(1, int(360000 * 8 / global_batch))
+    assert float(sched(last + 1)) == pytest.approx(base * 1e-3, rel=1e-4)
+
+
+def test_lr_schedule_collision_accumulates(fresh_config):
+    """Two boundaries that rescale to the same step (both clamp to 1 at
+    an absurd global batch) apply both decays at that step."""
+    cfg = fresh_config
+    cfg.TRAIN.NUM_CHIPS = 1000000
+    cfg.TRAIN.BATCH_SIZE_PER_CHIP = 1
+    cfg.TRAIN.BASE_LR = 0.01
+    cfg.TRAIN.LR_SCHEDULE = (240000, 320000, 360000)
+    cfg.TRAIN.WARMUP_STEPS = 0
+    sched = lr_schedule(cfg)
+    base = 0.01 * 1000000 / 8
+    assert float(sched(2)) == pytest.approx(base * 1e-3, rel=1e-4)
+
+
 def test_lr_warmup_then_base(fresh_config):
     cfg = fresh_config
     cfg.TRAIN.NUM_CHIPS = 8

@@ -210,3 +210,25 @@ def test_graft_entry_surface():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert callable(mod.entry) and callable(mod.dryrun_multichip)
+
+
+def test_gcs_storage_variant():
+    """Both storage paths of the reference exist (EFS≙Filestore-NFS,
+    FSx≙GCS-FUSE, eks-cluster/pv-kubeflow-fsx.yaml:14-20): the GCS
+    PV/PVC pair is a valid CSI volume, and selecting data_fs: gcs in
+    the chart turns on the GCS-FUSE sidecar annotation the CSI driver
+    requires."""
+    docs = [d for d in yaml.safe_load_all(_read("infra/k8s/gcs-sc.yaml"))
+            if d]
+    kinds = {d["kind"] for d in docs}
+    assert {"PersistentVolume", "PersistentVolumeClaim"} <= kinds
+    pv = next(d for d in docs if d["kind"] == "PersistentVolume")
+    pvc = next(d for d in docs if d["kind"] == "PersistentVolumeClaim")
+    assert pv["spec"]["csi"]["driver"] == "gcsfuse.csi.storage.gke.io"
+    assert pvc["spec"]["volumeName"] == pv["metadata"]["name"]
+    assert "ReadWriteMany" in pv["spec"]["accessModes"]
+
+    for chart in ("charts/maskrcnn", "charts/maskrcnn-optimized"):
+        tmpl = _read(f"{chart}/templates/maskrcnn.yaml")
+        assert 'eq .Values.maskrcnn.data_fs "gcs"' in tmpl, chart
+        assert 'gke-gcsfuse/volumes: "true"' in tmpl, chart

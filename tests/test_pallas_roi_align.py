@@ -99,3 +99,36 @@ def test_gradient_matches_reference():
         fs, rois, STRIDES, 7).sum())(feats)
     for a, b in zip(gp, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_extreme_aspect_ratio_fwd_bwd_consistent():
+    """A ROI whose extent at the heuristic level overflows the tile is
+    bumped to a coarser level (assign_fpn_levels_tile_fit); the Pallas
+    forward and the XLA backward must use that SAME assignment, so the
+    kernel output equals the XLA value at the bumped level and the
+    gradient flows into the bumped level's feature map."""
+    from eksml_tpu.ops.roi_align import (assign_fpn_levels,
+                                         assign_fpn_levels_tile_fit)
+
+    rng = np.random.RandomState(5)
+    feats = _feats(rng, img=1024, c=8)
+    # 900x12 px sliver: sqrt(area)~104 -> heuristic P3 (stride 8),
+    # extent 900/8 = 112 > TILE-3 -> bumped to P4 (56 fits)
+    rois = jnp.asarray([[[50.0, 100.0, 950.0, 112.0]]], jnp.float32)
+    flat = rois.reshape(1, 4)
+    heur = assign_fpn_levels(flat, 2, 5) - 2
+    fit = assign_fpn_levels_tile_fit(flat, STRIDES, 4, TILE)
+    assert int(fit[0]) > int(heur[0])  # the bump actually triggered
+
+    ref = batched_multilevel_roi_align(
+        feats, rois, STRIDES, 7, levels=fit.reshape(1, 1))
+    pal = pallas_batched_multilevel_roi_align(feats, rois, STRIDES, 7, 2,
+                                              2, True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref), atol=1e-4)
+
+    gp = jax.grad(lambda fs: pallas_batched_multilevel_roi_align(
+        fs, rois, STRIDES, 7, 2, 2, True).sum())(feats)
+    gr = jax.grad(lambda fs: batched_multilevel_roi_align(
+        fs, rois, STRIDES, 7, levels=fit.reshape(1, 1)).sum())(feats)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)

@@ -8,6 +8,8 @@ draw_final_outputs) — none of which the reference can check without a
 live cluster.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -34,6 +36,62 @@ def _tiny(cfg, tmp_path):
     return cfg
 
 
+def test_predictor_matches_eval_runner(fresh_config, tmp_path):
+    """OfflinePredictor and the eval runner must produce identical
+    detections for the same image (round-1 bug: the predictor clipped
+    boxes to the padded canvas instead of the resized content extent,
+    predictor.py:101)."""
+    import jax
+    import jax.numpy as jnp
+
+    from eksml_tpu.data import SyntheticDataset
+    from eksml_tpu.data.loader import resize_and_pad
+    from eksml_tpu.models import MaskRCNN
+    from eksml_tpu.predict import OfflinePredictor
+
+    cfg = _tiny(fresh_config, tmp_path)
+    cfg.freeze()
+
+    # non-square image so the padded canvas differs from (nh, nw)
+    ds = SyntheticDataset(num_images=1, height=128, width=80,
+                          num_classes=cfg.DATA.NUM_CLASSES)
+    img = ds.records()[0]["_image"]
+    h, w = img.shape[:2]
+
+    model = MaskRCNN.from_config(cfg)
+    im, scale, (nh, nw) = resize_and_pad(
+        img, cfg.PREPROC.TEST_SHORT_EDGE_SIZE, cfg.PREPROC.MAX_SIZE)
+    mean = np.asarray(cfg.PREPROC.PIXEL_MEAN, np.float32)
+    std = np.asarray(cfg.PREPROC.PIXEL_STD, np.float32)
+    norm = (im - mean) / std
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(norm[None]),
+                        jnp.asarray([[nh, nw]], np.float32),
+                        method=MaskRCNN.predict)["params"]
+
+    # eval-runner path (evalcoco/runner.py): hw = resized content dims
+    out = model.apply({"params": params}, jnp.asarray(norm[None]),
+                      jnp.asarray([[nh, nw]], np.float32),
+                      method=MaskRCNN.predict)
+    out = jax.tree.map(np.asarray, out)
+    keep = out["valid"][0] > 0
+    runner_boxes = np.clip(out["boxes"][0][keep] / scale,
+                           0, [w, h, w, h]).astype(np.float32)
+    runner_scores = out["scores"][0][keep]
+    runner_classes = out["classes"][0][keep]
+
+    # predictor path on the raw image
+    pred = OfflinePredictor(cfg, params=params)
+    results = pred(img, score_thresh=-1.0)
+
+    assert len(results) == int(keep.sum())
+    order = np.argsort(-runner_scores, kind="stable")
+    for r, j in zip(results, order):
+        np.testing.assert_allclose(r.box, runner_boxes[j], atol=1e-4)
+        np.testing.assert_allclose(r.score, runner_scores[j], atol=1e-6)
+        assert r.class_id == int(runner_classes[j])
+
+
 @pytest.mark.slow
 def test_train_checkpoint_restore_predict(fresh_config, tmp_path):
     from eksml_tpu.data import DetectionLoader, SyntheticDataset
@@ -50,9 +108,17 @@ def test_train_checkpoint_restore_predict(fresh_config, tmp_path):
                              with_masks=True, gt_mask_size=28)
 
     trainer = Trainer(cfg, cfg.TRAIN.LOGDIR)
-    state = trainer.fit(loader.batches(None), total_steps=2)
+    state = trainer.fit(loader.batches(None), total_steps=2,
+                        profile_steps=1)
     assert int(np.asarray(state.step)) == 2
     assert trainer.ckpt.latest_step() == 2
+
+    # --profile N: a TensorBoard-profile trace landed in the logdir
+    import glob
+
+    traces = glob.glob(os.path.join(cfg.TRAIN.LOGDIR, "profile",
+                                    "**", "*.xplane.pb"), recursive=True)
+    assert traces, "no profiler trace written"
 
     # auto-resume: a fresh Trainer picks up at the saved step
     trainer2 = Trainer(cfg, cfg.TRAIN.LOGDIR)

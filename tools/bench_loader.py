@@ -1,0 +1,87 @@
+"""Standalone input-pipeline throughput bench (VERDICT r1 item 3).
+
+The reference outsources this concern to TensorPack's multiprocess
+DataFlow (external, container/Dockerfile:16-19); here the host must
+sustain decode+resize+rasterize faster than the TPU consumes batches —
+at batch 4/chip × 4 chips/host of 1344² images, roughly
+``1.5 × chip_imgs_per_sec × 4`` images/sec per host.
+
+Prints ONE JSON line:
+    {"metric": "loader_throughput", "value": N, "unit":
+     "images/sec/host", ...}
+
+Run: ``python tools/bench_loader.py [--batches 20] [--workers 8]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="eksml_tpu loader bench")
+    p.add_argument("--image-size", type=int, default=1344,
+                   help="PREPROC.MAX_SIZE operating point")
+    p.add_argument("--batch-size", type=int, default=16,
+                   help="per-host batch (4 chips × batch 4)")
+    p.add_argument("--batches", type=int, default=20)
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--source-hw", type=int, nargs=2, default=(480, 640),
+                   help="raw image size before resize (COCO median-ish)")
+    p.add_argument("--no-masks", action="store_true")
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from eksml_tpu.config import config as cfg
+    from eksml_tpu.data import DetectionLoader, SyntheticDataset
+
+    cfg.freeze(False)
+    cfg.PREPROC.MAX_SIZE = args.image_size
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (args.image_size - 344,
+                                         args.image_size - 320)
+    cfg.freeze()
+
+    h, w = args.source_hw
+    ds = SyntheticDataset(num_images=64, height=h, width=w,
+                          num_classes=cfg.DATA.NUM_CLASSES)
+    loader = DetectionLoader(ds.records(), cfg, args.batch_size,
+                             with_masks=not args.no_masks,
+                             num_workers=args.workers)
+
+    it = loader.batches(args.batches + 2)
+    # warm: first batches pay thread-pool spin-up
+    next(it)
+    next(it)
+    t0 = time.time()
+    n = 0
+    for batch in it:
+        n += batch["images"].shape[0]
+        assert batch["images"].shape[1] == args.image_size
+    dt = time.time() - t0
+    per_sec = n / dt
+    print(f"loader: {n} images in {dt:.1f}s "
+          f"({args.workers} workers, masks={not args.no_masks})",
+          file=sys.stderr)
+    import os
+
+    cores = os.cpu_count() or 1
+    print(json.dumps({
+        "metric": "loader_throughput",
+        "value": round(per_sec, 2),
+        "unit": "images/sec/host",
+        "images_per_sec_per_core": round(per_sec / cores, 2),
+        "cpu_cores": cores,
+        "image_size": args.image_size,
+        "batch_size": args.batch_size,
+        "workers": args.workers,
+        "with_masks": not args.no_masks,
+    }))
+    return per_sec
+
+
+if __name__ == "__main__":
+    main()

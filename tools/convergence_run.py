@@ -1,0 +1,151 @@
+"""Convergence-evidence run (VERDICT r1 item 7).
+
+Trains the full Mask-RCNN pipeline for a few hundred steps on the
+learnable shapes dataset (tools/make_shapes_coco.py — real COCO is
+unreachable without egress), then asserts the two convergence facts the
+reference's manual ladder watches in TensorBoard
+(charts/maskrcnn/values.yaml:16):
+
+  1. total_loss drops materially from its early average, and
+  2. val bbox AP is meaningfully > 0 by the end.
+
+Writes the loss curve + final APs as a JSON artifact for the repo
+(artifacts/convergence_rN.json).
+
+Usage::
+
+    python tools/convergence_run.py --steps 300 --out \
+        artifacts/convergence_r2.json [--platform cpu] [--size 320]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--size", type=int, default=320)
+    p.add_argument("--num-train", type=int, default=200)
+    p.add_argument("--num-val", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--out", default=None)
+    p.add_argument("--platform", default=None,
+                   help="force jax platform (cpu/tpu)")
+    p.add_argument("--data", default=None,
+                   help="reuse an existing shapes dataset dir")
+    p.add_argument("--no-check", action="store_true",
+                   help="emit the artifact without convergence asserts "
+                        "(pipeline smoke)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from tools.make_shapes_coco import make_split
+
+    if args.data:
+        base = args.data
+    else:
+        base = tempfile.mkdtemp(prefix="shapes_coco_")
+        make_split(base, "train2017", args.num_train, args.size, 0, 1000)
+        make_split(base, "val2017", args.num_val, args.size, 1, 100000)
+        print(f"dataset at {base}", file=sys.stderr)
+
+    from eksml_tpu.config import config as cfg
+    from eksml_tpu.config import finalize_configs
+    from eksml_tpu.data import CocoDataset, DetectionLoader
+    from eksml_tpu.evalcoco import run_evaluation
+    from eksml_tpu.train import Trainer
+
+    size = args.size
+    cfg.freeze(False)
+    cfg.DATA.BASEDIR = base
+    cfg.DATA.NUM_CLASSES = 4          # BG + box/blob/wedge
+    cfg.PREPROC.MAX_SIZE = size
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (size, size)
+    cfg.PREPROC.TEST_SHORT_EDGE_SIZE = size
+    cfg.DATA.MAX_GT_BOXES = 8
+    cfg.TRAIN.BASE_LR = 0.01
+    cfg.TRAIN.WARMUP_STEPS = 100
+    # boundary far past the run but int32-safe after the ×8/batch
+    # rescale (a 1e9 sentinel overflowed jit argument parsing)
+    cfg.TRAIN.LR_SCHEDULE = (10 ** 6,)  # constant post-warmup
+    cfg.TRAIN.STEPS_PER_EPOCH = args.steps
+    cfg.TRAIN.MAX_EPOCHS = 1
+    cfg.TRAIN.CHECKPOINT_PERIOD = 1
+    cfg.TRAIN.LOG_PERIOD = 10
+    cfg.TRAIN.NUM_CHIPS = 1
+    cfg.TPU.MESH_SHAPE = (1, 1)
+    cfg.BACKBONE.WEIGHTS = ""
+    logdir = os.path.join(base, "run")
+    cfg.TRAIN.LOGDIR = logdir
+    finalize_configs(is_training=True)
+
+    ds = CocoDataset(base, "train2017")
+    records = ds.records()
+    loader = DetectionLoader(records, cfg, args.batch_size,
+                             is_training=True, seed=0,
+                             with_masks=cfg.MODE_MASK)
+
+    trainer = Trainer(cfg, logdir)
+    t0 = time.time()
+    state = trainer.fit(loader.batches(None), total_steps=args.steps)
+    train_time = time.time() - t0
+
+    # loss curve from the metric writer's JSONL
+    curve = []
+    with open(os.path.join(logdir, "metrics.jsonl")) as f:
+        for line in f:
+            d = json.loads(line)
+            if "total_loss" in d:
+                curve.append({"step": d["step"],
+                              "total_loss": round(d["total_loss"], 4)})
+
+    val = CocoDataset(base, "val2017").records(skip_empty=False)
+    results = run_evaluation(trainer.model, state.params, cfg, val)
+
+    n = max(1, len(curve) // 5)
+    early = float(np.mean([c["total_loss"] for c in curve[:n]]))
+    late = float(np.mean([c["total_loss"] for c in curve[-n:]]))
+    summary = {
+        "steps": args.steps,
+        "image_size": size,
+        "batch_size": args.batch_size,
+        "train_seconds": round(train_time, 1),
+        "early_loss": round(early, 4),
+        "late_loss": round(late, 4),
+        "loss_drop_pct": round(100 * (1 - late / early), 1),
+        "bbox_AP": round(results.get("bbox/AP", -1), 4),
+        "bbox_AP50": round(results.get("bbox/AP50", -1), 4),
+        "segm_AP": round(results.get("segm/AP", -1), 4),
+        "device": jax.devices()[0].device_kind,
+        "curve": curve,
+    }
+    out = json.dumps(summary)
+    print(out)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+
+    if not args.no_check:
+        assert late < 0.7 * early, \
+            f"loss did not drop materially: {early:.3f} -> {late:.3f}"
+        assert results.get("bbox/AP50", 0) > 0.05, \
+            f"bbox AP50 too low: {results.get('bbox/AP50')}"
+        print("convergence OK", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
