@@ -113,6 +113,10 @@ def main(argv=None):
                    help="first retry wait; doubles per attempt")
     p.add_argument("--init-timeout", type=float, default=180.0,
                    help="per-attempt deadline on backend init")
+    p.add_argument("--platform", default=None,
+                   help="pin the jax platform (cpu for a smoke run; "
+                        "the env-var route is pre-empted by site "
+                        "config on some hosts)")
     p.add_argument("--profile", type=int, default=0, metavar="N",
                    help="capture a jax.profiler trace of N timed steps "
                         "into ./profile/")
@@ -151,6 +155,10 @@ def main(argv=None):
 
 def run(args, diag: dict) -> None:
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -207,13 +215,15 @@ def run(args, diag: dict) -> None:
 
     # compiled-HLO FLOPs per step → MFU (VERDICT r1: "MFU is computed
     # nowhere").  cost_analysis counts the actual fused program, a
-    # better estimate than a hand model of the architecture.
+    # better estimate than a hand model of the architecture.  The AOT
+    # executable REPLACES the jit dispatch (compiling once, not twice).
     flops_per_step = None
     try:
-        lowered = step.lower(params, opt_state, batch, rng)
-        cost = lowered.compile().cost_analysis()
+        compiled = step.lower(params, opt_state, batch, rng).compile()
+        cost = compiled.cost_analysis()
         if cost:
             flops_per_step = float(cost.get("flops", 0.0)) or None
+        step = compiled
     except Exception as e:  # noqa: BLE001 — MFU is best-effort
         print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
 

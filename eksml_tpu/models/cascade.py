@@ -22,7 +22,7 @@ is a masked IoU argmax, never a dynamic filter.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -34,17 +34,22 @@ from eksml_tpu.ops.boxes import (clip_boxes, decode_boxes, encode_boxes,
 
 
 class CascadeBoxHead(nn.Module):
-    """2-FC head with per-class logits + class-agnostic deltas."""
+    """2-FC head with per-class logits + class-agnostic deltas.
+    ``dtype``: compute dtype (bf16 under the optimized chart); outputs
+    are cast back to f32 for loss/refinement precision."""
     num_classes: int = 81
     fc_dim: int = 1024
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, roi_feats: jnp.ndarray):
-        x = roi_feats.reshape(roi_feats.shape[0], -1)
-        x = nn.relu(nn.Dense(self.fc_dim, name="fc6")(x))
-        x = nn.relu(nn.Dense(self.fc_dim, name="fc7")(x))
-        logits = nn.Dense(self.num_classes, name="class")(x)
-        deltas = nn.Dense(4, name="box")(x)
+        x = roi_feats.astype(self.dtype).reshape(roi_feats.shape[0], -1)
+        x = nn.relu(nn.Dense(self.fc_dim, name="fc6", dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.fc_dim, name="fc7", dtype=self.dtype)(x))
+        logits = nn.Dense(self.num_classes, name="class",
+                          dtype=self.dtype)(x).astype(jnp.float32)
+        deltas = nn.Dense(4, name="box",
+                          dtype=self.dtype)(x).astype(jnp.float32)
         return logits, deltas
 
 

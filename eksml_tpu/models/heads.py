@@ -10,7 +10,7 @@ mask-weighted over static shapes (SURVEY.md §7 hard part #1).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax
@@ -21,34 +21,46 @@ from eksml_tpu.models.rpn import smooth_l1
 
 
 class BoxHead(nn.Module):
-    """2-FC head → per-class logits + class-agnostic-per-class deltas."""
+    """2-FC head → per-class logits + class-agnostic-per-class deltas.
+
+    ``dtype`` is the compute dtype (TRAIN.PRECISION): the FC matmuls —
+    512 ROIs × 12544 × 1024 per image — run on the MXU in bf16 under
+    the optimized operating point; params stay f32 and OUTPUTS are
+    cast back to f32 so losses/decoding keep full precision."""
     num_classes: int = 81
     fc_dim: int = 1024
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, roi_feats: jnp.ndarray):
         # roi_feats: [N, P, P, C]
-        x = roi_feats.reshape(roi_feats.shape[0], -1)
-        x = nn.relu(nn.Dense(self.fc_dim, name="fc6")(x))
-        x = nn.relu(nn.Dense(self.fc_dim, name="fc7")(x))
-        logits = nn.Dense(self.num_classes, name="class")(x)
-        deltas = nn.Dense(self.num_classes * 4, name="box")(x)
+        x = roi_feats.astype(self.dtype).reshape(roi_feats.shape[0], -1)
+        x = nn.relu(nn.Dense(self.fc_dim, name="fc6", dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.fc_dim, name="fc7", dtype=self.dtype)(x))
+        logits = nn.Dense(self.num_classes, name="class",
+                          dtype=self.dtype)(x).astype(jnp.float32)
+        deltas = nn.Dense(self.num_classes * 4, name="box",
+                          dtype=self.dtype)(x).astype(jnp.float32)
         return logits, deltas.reshape(-1, self.num_classes, 4)
 
 
 class MaskHead(nn.Module):
-    """4x conv3x3 + deconv2x + 1x1 per-class mask logits."""
+    """4x conv3x3 + deconv2x + 1x1 per-class mask logits.  Convs run in
+    ``dtype`` (bf16 under the optimized chart); logits return f32."""
     num_classes: int = 81
     dim: int = 256
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, roi_feats: jnp.ndarray):
-        x = roi_feats
+        x = roi_feats.astype(self.dtype)
         for i in range(4):
-            x = nn.relu(nn.Conv(self.dim, (3, 3), name=f"fcn{i}")(x))
+            x = nn.relu(nn.Conv(self.dim, (3, 3), name=f"fcn{i}",
+                                dtype=self.dtype)(x))
         x = nn.relu(nn.ConvTranspose(self.dim, (2, 2), strides=(2, 2),
-                                     name="deconv")(x))
-        return nn.Conv(self.num_classes, (1, 1), name="conv")(x)
+                                     name="deconv", dtype=self.dtype)(x))
+        return nn.Conv(self.num_classes, (1, 1), name="conv",
+                       dtype=self.dtype)(x).astype(jnp.float32)
 
 
 def sample_proposal_targets(

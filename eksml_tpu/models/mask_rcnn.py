@@ -127,30 +127,39 @@ class MaskRCNN(nn.Module):
                                name="backbone")
         self.fpn = fpn_cls(num_channels=self.fpn_channels, name="fpn")
         self.rpn_head = RPNHead(num_anchors=len(self.anchor_ratios),
-                                channels=self.fpn_channels, name="rpn")
+                                channels=self.fpn_channels,
+                                dtype=self.compute_dtype, name="rpn")
         if self.cascade:
             from eksml_tpu.models.cascade import CascadeBoxHead
 
             self.cascade_heads = [
                 CascadeBoxHead(num_classes=self.num_classes,
                                fc_dim=self.fc_head_dim,
+                               dtype=self.compute_dtype,
                                name=f"cascade{i}")
                 for i in range(len(self.cascade_ious))]
         else:
             self.box_head = BoxHead(num_classes=self.num_classes,
                                     fc_dim=self.fc_head_dim,
+                                    dtype=self.compute_dtype,
                                     name="fastrcnn")
         if self.with_masks:
             self.mask_head = MaskHead(num_classes=self.num_classes,
-                                      dim=self.mask_head_dim, name="maskrcnn")
+                                      dim=self.mask_head_dim,
+                                      dtype=self.compute_dtype,
+                                      name="maskrcnn")
 
     # ---- shared trunk ------------------------------------------------
 
     def _features(self, images: jnp.ndarray):
+        """P2..P6 in ``compute_dtype``.  Under bf16 the features STAY
+        bf16 through ROIAlign and the heads — halving the HBM traffic
+        of the gather path and keeping head matmuls on the bf16 MXU;
+        every head casts its own outputs back to f32, so losses,
+        proposal decoding and NMS run at full precision."""
         x = images.astype(self.compute_dtype)
         c_feats = self.backbone(x)
-        p_feats = self.fpn(c_feats)  # P2..P6
-        return [f.astype(jnp.float32) for f in p_feats]
+        return self.fpn(c_feats)  # P2..P6
 
     def _anchors(self, image_hw: Tuple[int, int]):
         levels = generate_fpn_anchors(image_hw, self.anchor_strides,

@@ -15,7 +15,7 @@ TPU-first divergences (SURVEY.md §7 hard part #1):
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -28,21 +28,28 @@ from eksml_tpu.ops.nms import nms_mask
 
 class RPNHead(nn.Module):
     """Shared 3x3 conv + 1x1 objectness / box-delta convs, applied to
-    every FPN level with shared parameters."""
+    every FPN level with shared parameters.  Convs run in ``dtype``
+    (bf16 under the optimized chart); outputs return f32 so proposal
+    decoding/NMS and losses keep full coordinate precision."""
     num_anchors: int = 3
     channels: int = 256
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, feats: Sequence[jnp.ndarray]):
-        conv = nn.Conv(self.channels, (3, 3), name="conv0")
-        cls = nn.Conv(self.num_anchors, (1, 1), name="class")
-        box = nn.Conv(self.num_anchors * 4, (1, 1), name="box")
+        conv = nn.Conv(self.channels, (3, 3), name="conv0",
+                       dtype=self.dtype)
+        cls = nn.Conv(self.num_anchors, (1, 1), name="class",
+                      dtype=self.dtype)
+        box = nn.Conv(self.num_anchors * 4, (1, 1), name="box",
+                      dtype=self.dtype)
         logits, deltas = [], []
         for f in feats:
-            h = nn.relu(conv(f))
+            h = nn.relu(conv(f.astype(self.dtype)))
             b, fh, fw, _ = h.shape
-            logits.append(cls(h).reshape(b, -1))
-            deltas.append(box(h).reshape(b, -1, 4))
+            logits.append(cls(h).reshape(b, -1).astype(jnp.float32))
+            deltas.append(
+                box(h).reshape(b, -1, 4).astype(jnp.float32))
         return logits, deltas
 
 

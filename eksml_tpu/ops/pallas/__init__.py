@@ -8,4 +8,5 @@ backend.
 """
 
 from eksml_tpu.ops.pallas.roi_align_kernel import (  # noqa: F401
-    pallas_batched_multilevel_roi_align, pallas_roi_align_supported)
+    TILE, pallas_batched_multilevel_roi_align, pallas_roi_align_supported,
+    sublane_align, tile_margin)

@@ -51,39 +51,53 @@ log = logging.getLogger(__name__)
 
 TILE = 64  # T: per-ROI feature tile (covers √area/stride ≲ 56 + taps)
 
-_PROBE_RESULT = None  # cached hardware compile-probe outcome
+_PROBE_RESULTS: dict = {}  # dtype → cached hardware compile-probe
 
 
-def _probe_compile() -> bool:
-    """Compile + run the kernel once on tiny real shapes.  The Mosaic
-    compiler is versioned independently of jax; a kernel that lowers in
-    interpret mode can still be rejected on hardware (round 1: the
-    whole training path died at bench time).  One cheap probe decides
-    the dispatch instead."""
+def sublane_align(dtype) -> int:
+    """Mosaic's second-to-last-dim tiling for HBM memrefs: 8 sublanes
+    × (32 / itemsize) packing — f32 tiles (8, 128), bf16 (16, 128).
+    Dynamic W-origin slices must be provably aligned to this."""
+    return 8 * (4 // np.dtype(dtype).itemsize)
+
+
+def tile_margin(dtype) -> int:
+    """Tile pixels unusable for ROI extent: 2 bilinear taps + origin
+    slack (3) plus up to align-1 of origin round-down."""
+    return 3 + sublane_align(dtype) - 1
+
+
+def _probe_compile(dtype) -> bool:
+    """Compile + run the kernel once on tiny real shapes OF THE
+    PRODUCTION DTYPE.  The Mosaic compiler is versioned independently
+    of jax; a kernel that lowers in interpret mode can still be
+    rejected on hardware (round 1: the whole training path died at
+    bench time), and bf16 memrefs have different tiling constraints
+    than f32 — probe what will actually run."""
     try:
         # production shape class: 4 FPN levels, C=256 (fpn.py) — the
         # multi-level @pl.when DMA selection and full scratch size must
         # compile, not just a toy single-level variant
         feats = tuple(jnp.zeros((1, max(TILE, 256 // s), max(TILE, 256 // s),
-                                 256), jnp.float32) for s in (4, 8, 16, 32))
+                                 256), dtype) for s in (4, 8, 16, 32))
         rois = jnp.asarray([[[4.0, 4.0, 36.0, 36.0],
                              [8.0, 8.0, 200.0, 120.0]]], jnp.float32)
         out = pallas_batched_multilevel_roi_align(
             feats, rois, (4, 8, 16, 32), 7, 2, 2)
         jax.block_until_ready(out)
-        return bool(np.isfinite(np.asarray(out)).all())
+        return bool(np.isfinite(
+            np.asarray(out, dtype=np.float32)).all())
     except Exception as e:  # noqa: BLE001 — any compile/runtime failure
-        log.warning("Pallas ROIAlign unavailable on this backend "
-                    "(falling back to XLA): %s", e)
+        log.warning("Pallas ROIAlign unavailable on this backend for "
+                    "%s (falling back to XLA): %s", np.dtype(dtype), e)
         return False
 
 
-def pallas_roi_align_supported() -> bool:
+def pallas_roi_align_supported(dtype=jnp.float32) -> bool:
     """True when the kernel path should be used: real TPU backend AND
-    the kernel compiles there (probed once, cached).  Overridable via
-    ``EKSML_ROI_BACKEND={auto,pallas,xla}`` — the A/B switch bench.py
-    exposes as ``--roi-backend``."""
-    global _PROBE_RESULT
+    the kernel compiles there for ``dtype`` (probed once per dtype,
+    cached).  Overridable via ``EKSML_ROI_BACKEND={auto,pallas,xla}``
+    — the A/B switch bench.py exposes as ``--roi-backend``."""
     mode = os.environ.get("EKSML_ROI_BACKEND", "auto").lower()
     if mode == "xla":
         return False
@@ -94,12 +108,13 @@ def pallas_roi_align_supported() -> bool:
             return False
     except Exception:
         return False
-    if _PROBE_RESULT is None:
-        _PROBE_RESULT = _probe_compile()
-    return _PROBE_RESULT
+    key = np.dtype(dtype).name
+    if key not in _PROBE_RESULTS:
+        _PROBE_RESULTS[key] = _probe_compile(dtype)
+    return _PROBE_RESULTS[key]
 
 
-def _kernel(out_size: int, sampling: int, num_levels: int,
+def _kernel(out_size: int, sampling: int, num_levels: int, align: int,
             # scalar prefetch (SMEM), one entry per ROI:
             lvl_ref, b_ref, y0_ref, x0_ref,   # int32 level/batch/origin
             ys_ref, xs_ref, bh_ref, bw_ref,   # f32 tile-local start/bin
@@ -116,10 +131,11 @@ def _kernel(out_size: int, sampling: int, num_levels: int,
     lvl = lvl_ref[r]
     b = b_ref[r]
     y0 = y0_ref[r]
-    # x0 arrives as a sublane-block count; multiplying by 8 here lets
-    # Mosaic PROVE the W-dim slice origin is 8-aligned (its HBM-slice
-    # tiling requirement — an SMEM value alone is unprovable)
-    x0 = x0_ref[r] * 8
+    # x0 arrives as a sublane-block count; multiplying by the dtype's
+    # sublane alignment (8 for f32 tiles (8,128), 16 for bf16 (16,128))
+    # here lets Mosaic PROVE the W-dim slice origin is aligned (its
+    # HBM-slice tiling requirement — an SMEM value alone is unprovable)
+    x0 = x0_ref[r] * align
 
     for i in range(num_levels):
         @pl.when(lvl == i)
@@ -175,7 +191,7 @@ def _kernel(out_size: int, sampling: int, num_levels: int,
     out_ref[0] = pooled.astype(out_ref.dtype)
 
 
-def _prep(feats, rois, strides, out_size, min_level):
+def _prep(feats, rois, strides, out_size, min_level, align):
     """Host-side (traced) index/weight prep: tile-fit level assignment,
     clamped tile origins, tile-local sample-start coordinates."""
     from eksml_tpu.ops.roi_align import assign_fpn_levels_tile_fit
@@ -183,7 +199,8 @@ def _prep(feats, rois, strides, out_size, min_level):
     b, n = rois.shape[0], rois.shape[1]
     flat = rois.reshape(b * n, 4)
     levels = assign_fpn_levels_tile_fit(
-        flat, strides, len(feats), TILE, min_level=min_level)  # [BN] in [0,L)
+        flat, strides, len(feats), TILE, min_level=min_level,
+        align=align)  # [BN] in [0,L)
     batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.int32), n)
 
     inv_strides = jnp.asarray([1.0 / s for s in strides], jnp.float32)
@@ -198,31 +215,32 @@ def _prep(feats, rois, strides, out_size, min_level):
     h_pad = jnp.asarray([f.shape[1] for f in feats], jnp.int32)[levels]
     w_pad = jnp.asarray([f.shape[2] for f in feats], jnp.int32)[levels]
     # aligned=True: samples start at y1 - 0.5; tile origin 1 tap early.
-    # The x origin is additionally rounded DOWN to a multiple of 8 and
-    # shipped as a block count (Mosaic requires provable 8-alignment of
-    # the W-dim HBM slice; _pad_levels makes w_pad ≡ 0 mod 8 so the
-    # clamp bound is itself aligned and right-edge coverage survives).
+    # The x origin is additionally rounded DOWN to the dtype's sublane
+    # alignment and shipped as a block count (Mosaic requires a provably
+    # aligned W-dim HBM slice; _pad_levels makes w_pad ≡ 0 mod align so
+    # the clamp bound is itself aligned and right-edge coverage
+    # survives).
     y0 = jnp.clip(jnp.floor(y1 - 1.5).astype(jnp.int32), 0,
                   jnp.maximum(h_pad - TILE, 0))
     x0 = jnp.clip(jnp.floor(x1 - 1.5).astype(jnp.int32), 0,
-                  jnp.maximum(w_pad - TILE, 0)) // 8 * 8
+                  jnp.maximum(w_pad - TILE, 0)) // align * align
 
     ys = y1 - 0.5 - y0.astype(jnp.float32)
     xs = x1 - 0.5 - x0.astype(jnp.float32)
-    return (levels.astype(jnp.int32), batch_idx, y0, x0 // 8,
+    return (levels.astype(jnp.int32), batch_idx, y0, x0 // align,
             ys, xs, bin_h, bin_w)
 
 
-def _pad_levels(feats):
+def _pad_levels(feats, align):
     """Zero-pad each level's spatial dims to ≥ TILE, and W additionally
-    to a multiple of 8 so the clamped tile x-origin stays sublane-
-    aligned (zero padding IS ROIAlign's out-of-image semantics, so this
-    is free correctness)."""
+    to a multiple of ``align`` so the clamped tile x-origin stays
+    sublane-aligned (zero padding IS ROIAlign's out-of-image semantics,
+    so this is free correctness)."""
     out = []
     for f in feats:
         _, h, w, _ = f.shape
         ph = max(TILE - h, 0)
-        pw = max(TILE - w, 0) or (-w % 8)
+        pw = max(TILE - w, 0) or (-w % align)
         if ph or pw:
             f = jnp.pad(f, ((0, 0), (0, ph), (0, pw), (0, 0)))
         out.append(f)
@@ -234,12 +252,14 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    feats = _pad_levels(feats)
+    align = sublane_align(feats[0].dtype)
+    feats = _pad_levels(feats, align)
     b, n = rois.shape[0], rois.shape[1]
     c = feats[0].shape[-1]
-    scalars = _prep(feats, rois, strides, out_size, min_level)
+    scalars = _prep(feats, rois, strides, out_size, min_level, align)
     num_levels = len(feats)
-    kern = functools.partial(_kernel, out_size, sampling, num_levels)
+    kern = functools.partial(_kernel, out_size, sampling, num_levels,
+                             align)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=8,
@@ -293,7 +313,8 @@ def _bwd(strides, out_size, sampling_ratio, min_level, interpret, res, g):
     b, n = rois.shape[0], rois.shape[1]
     levels = assign_fpn_levels_tile_fit(
         rois.reshape(b * n, 4), strides, len(feats), TILE,
-        min_level=min_level).reshape(b, n)
+        min_level=min_level,
+        align=sublane_align(feats[0].dtype)).reshape(b, n)
     _, vjp = jax.vjp(
         lambda fs: batched_multilevel_roi_align(
             fs, rois, strides, out_size, sampling_ratio, min_level,

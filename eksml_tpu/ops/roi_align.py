@@ -93,23 +93,28 @@ def assign_fpn_levels(rois: jnp.ndarray, min_level: int = 2,
 
 def assign_fpn_levels_tile_fit(rois: jnp.ndarray, strides: Sequence[int],
                                num_levels: int, tile: int,
-                               min_level: int = 2) -> jnp.ndarray:
+                               min_level: int = 2,
+                               align: int = 8) -> jnp.ndarray:
     """Level *indices* (``[N]`` in ``[0, num_levels)``) for the Pallas
     tile kernel: the FPN heuristic, bumped to a coarser level whenever
     the ROI's extent at the assigned level would not fit in a
     ``tile × tile`` feature window (extreme aspect ratios).  Forward
     kernel and XLA backward both use this assignment so their values
-    agree exactly.  Assumes FPN's ``strides[l] = strides[0] · 2^l``."""
+    agree exactly.  Assumes FPN's ``strides[l] = strides[0] · 2^l``.
+
+    ``align``: the kernel's sublane alignment for the feature dtype
+    (8 for f32, 16 for bf16) — the tile x-origin is rounded down by up
+    to align-1 px, shrinking the usable extent."""
     levels = assign_fpn_levels(
         rois, min_level=min_level,
         max_level=min_level + num_levels - 1) - min_level
     w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
     h = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
     extent = jnp.maximum(jnp.maximum(w, h), 1e-4)
-    # need extent/strides[l] ≤ tile-11: 2 bilinear taps + origin slack
-    # + up to 7 px of sublane alignment (the kernel's tile x-origin is
-    # rounded down to a multiple of 8 — Mosaic's HBM slice constraint)
-    need = jnp.ceil(jnp.log2(extent / ((tile - 11.0) * strides[0])))
+    # need extent/strides[l] ≤ tile - (2 bilinear taps + origin slack
+    # + up to align-1 px of sublane round-down)
+    usable = float(tile - 3 - (align - 1))
+    need = jnp.ceil(jnp.log2(extent / (usable * strides[0])))
     levels = jnp.maximum(levels, need.astype(jnp.int32))
     return jnp.clip(levels, 0, num_levels - 1)
 
@@ -165,11 +170,23 @@ def dispatch_roi_align(feats, rois, strides, out_size,
                        sampling_ratio: int = 2, min_level: int = 2):
     """Backend dispatch: the Pallas kernel on real TPU (assigned-level
     tile DMA + separable MXU matmuls, ops/pallas/roi_align_kernel.py),
-    the XLA gather formulation elsewhere."""
-    from eksml_tpu.ops.pallas import (pallas_batched_multilevel_roi_align,
-                                      pallas_roi_align_supported)
+    the XLA gather formulation elsewhere.
 
-    if pallas_roi_align_supported():
+    Correctness guard: an ROI wider than the kernel's coverage at the
+    COARSEST level — ``(TILE - margin) × strides[-1]`` px, ~1696 (f32)
+    / ~1440 (bf16) with TILE=64 — would be silently truncated by the
+    tile while the XLA backward computes the full gradient.  ROI extent
+    is bounded by the (padded) image extent, so when the feature maps
+    imply images beyond that bound, dispatch takes the XLA path."""
+    from eksml_tpu.ops.pallas import (TILE,
+                                      pallas_batched_multilevel_roi_align,
+                                      pallas_roi_align_supported,
+                                      sublane_align, tile_margin)
+
+    dtype = feats[0].dtype
+    img_extent = max(feats[0].shape[1], feats[0].shape[2]) * strides[0]
+    coverage = (TILE - tile_margin(dtype)) * strides[-1]
+    if img_extent <= coverage and pallas_roi_align_supported(dtype):
         return pallas_batched_multilevel_roi_align(
             tuple(feats), rois, tuple(strides), out_size, sampling_ratio,
             min_level)

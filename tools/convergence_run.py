@@ -43,6 +43,9 @@ def main(argv=None):
     p.add_argument("--no-check", action="store_true",
                    help="emit the artifact without convergence asserts "
                         "(pipeline smoke)")
+    p.add_argument("--config", nargs="*", default=[],
+                   help="KEY=VALUE overrides (e.g. shrink the model "
+                        "for a CPU smoke)")
     args = p.parse_args(argv)
 
     import jax
@@ -84,12 +87,13 @@ def main(argv=None):
     cfg.TRAIN.STEPS_PER_EPOCH = args.steps
     cfg.TRAIN.MAX_EPOCHS = 1
     cfg.TRAIN.CHECKPOINT_PERIOD = 1
-    cfg.TRAIN.LOG_PERIOD = 10
+    cfg.TRAIN.LOG_PERIOD = max(1, min(10, args.steps // 6))
     cfg.TRAIN.NUM_CHIPS = 1
     cfg.TPU.MESH_SHAPE = (1, 1)
     cfg.BACKBONE.WEIGHTS = ""
     logdir = os.path.join(base, "run")
     cfg.TRAIN.LOGDIR = logdir
+    cfg.update_args(args.config)
     finalize_configs(is_training=True)
 
     ds = CocoDataset(base, "train2017")
