@@ -48,7 +48,17 @@ def _bilinear_resize(img: np.ndarray, nh: int, nw: int) -> np.ndarray:
     sampling as the 2-D gather formulation but ~7× faster (2 small
     gathers/blends instead of 4 full-size ones — measured 32 ms vs
     222 ms for 640×480→1344×1008 f32; the loader must outrun the TPU
-    step rate, VERDICT r1 item 3)."""
+    step rate, VERDICT r1 item 3).
+
+    Dispatches to the C++ implementation (data/native.py, GIL-released
+    so decode worker threads scale with cores) when built; this numpy
+    body is the semantic reference and fallback."""
+    from eksml_tpu.data.native import resize_bilinear_native
+
+    if img.ndim == 3 and img.dtype == np.float32:
+        out = resize_bilinear_native(img, nh, nw)
+        if out is not None:
+            return out
     h, w = img.shape[:2]
     yy = (np.arange(nh) + 0.5) * h / nh - 0.5
     xx = (np.arange(nw) + 0.5) * w / nw - 0.5

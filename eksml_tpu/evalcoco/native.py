@@ -14,72 +14,41 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 from typing import Optional, Sequence
 
 import numpy as np
 
+from eksml_tpu._native import NativeLib
+
 log = logging.getLogger(__name__)
 
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "_maskops.so")
-_SRC_DIR = os.path.join(os.path.dirname(__file__), "native_src")
-_lib = None
-_load_attempted = False
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.mask_iou_dense.argtypes = [u8p, ctypes.c_int64, u8p,
+                                   ctypes.c_int64, u8p, ctypes.c_int64,
+                                   f64p]
+    lib.mask_iou_dense.restype = None
+    lib.rle_encode_dense.argtypes = [u8p, ctypes.c_int64,
+                                     ctypes.c_int64, u32p]
+    lib.rle_encode_dense.restype = ctypes.c_int64
+    lib.rle_iou.argtypes = [u32p, i64p, ctypes.c_int64, u32p, i64p,
+                            ctypes.c_int64, u8p, f64p]
+    lib.rle_iou.restype = None
 
 
-def _try_build() -> bool:
-    try:
-        subprocess.run(["make", "-C", _SRC_DIR], check=True,
-                       capture_output=True, timeout=120)
-        return os.path.exists(_LIB_PATH)
-    except Exception as e:
-        log.debug("native maskops build failed: %s", e)
-        return False
-
-
-def _stale() -> bool:
-    """True when the source is newer than the built library."""
-    src = os.path.join(_SRC_DIR, "maskops.cc")
-    try:
-        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
-    except OSError:
-        return False
+_LIB = NativeLib(
+    os.path.join(os.path.dirname(__file__), "_maskops.so"),
+    os.path.join(os.path.dirname(__file__), "native_src"),
+    "maskops.cc", _declare)
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
     """Load (building on first use / source change) the native library."""
-    global _lib, _load_attempted
-    if _lib is not None or _load_attempted:
-        return _lib
-    _load_attempted = True
-    if (not os.path.exists(_LIB_PATH) or _stale()) and not _try_build():
-        if not os.path.exists(_LIB_PATH):
-            log.info("native maskops unavailable; using numpy fallback")
-            return None
-        log.warning("maskops.cc changed but rebuild failed; NOT loading "
-                    "the stale %s — using numpy fallback", _LIB_PATH)
-        return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        u32p = ctypes.POINTER(ctypes.c_uint32)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        f64p = ctypes.POINTER(ctypes.c_double)
-        lib.mask_iou_dense.argtypes = [u8p, ctypes.c_int64, u8p,
-                                       ctypes.c_int64, u8p, ctypes.c_int64,
-                                       f64p]
-        lib.mask_iou_dense.restype = None
-        lib.rle_encode_dense.argtypes = [u8p, ctypes.c_int64,
-                                         ctypes.c_int64, u32p]
-        lib.rle_encode_dense.restype = ctypes.c_int64
-        lib.rle_iou.argtypes = [u32p, i64p, ctypes.c_int64, u32p, i64p,
-                                ctypes.c_int64, u8p, f64p]
-        lib.rle_iou.restype = None
-        _lib = lib
-    except (OSError, AttributeError) as e:
-        # AttributeError: symbol mismatch (old binary / changed ABI)
-        log.warning("failed to load %s: %s", _LIB_PATH, e)
-    return _lib
+    return _LIB.get()
 
 
 def _as_u8(m: np.ndarray) -> np.ndarray:

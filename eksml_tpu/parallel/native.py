@@ -11,63 +11,37 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 from typing import List, Optional, Tuple
+
+from eksml_tpu._native import NativeLib
 
 log = logging.getLogger(__name__)
 
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "_topology.so")
-_SRC_DIR = os.path.join(os.path.dirname(__file__), "native_src")
-_lib = None
-_load_attempted = False
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.topo_lookup.argtypes = [ctypes.c_char_p, i32p, i32p, i32p, i32p]
+    lib.topo_lookup.restype = ctypes.c_int32
+    lib.topo_validate.argtypes = [ctypes.c_int32, ctypes.c_int32]
+    lib.topo_validate.restype = ctypes.c_int32
+    lib.topo_chip_coords.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                     i32p, i32p]
+    lib.topo_chip_coords.restype = ctypes.c_int32
+    lib.topo_host_ring.argtypes = [ctypes.c_char_p, i32p]
+    lib.topo_host_ring.restype = ctypes.c_int32
+    lib.combine_threshold_bytes.argtypes = [ctypes.c_int64,
+                                            ctypes.c_int32]
+    lib.combine_threshold_bytes.restype = ctypes.c_int64
 
 
-def _stale() -> bool:
-    src = os.path.join(_SRC_DIR, "topology.cc")
-    try:
-        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
-    except OSError:
-        return False
+_LIB = NativeLib(
+    os.path.join(os.path.dirname(__file__), "_topology.so"),
+    os.path.join(os.path.dirname(__file__), "native_src"),
+    "topology.cc", _declare)
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    global _lib, _load_attempted
-    if _lib is not None or _load_attempted:
-        return _lib
-    _load_attempted = True
-    if not os.path.exists(_LIB_PATH) or _stale():
-        try:
-            subprocess.run(["make", "-C", _SRC_DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception as e:
-            log.debug("topology shim build failed: %s", e)
-        if not os.path.exists(_LIB_PATH):
-            return None
-        if _stale():
-            log.warning("topology.cc changed but rebuild failed; NOT "
-                        "loading the stale %s — using python fallback",
-                        _LIB_PATH)
-            return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        lib.topo_lookup.argtypes = [ctypes.c_char_p, i32p, i32p, i32p, i32p]
-        lib.topo_lookup.restype = ctypes.c_int32
-        lib.topo_validate.argtypes = [ctypes.c_int32, ctypes.c_int32]
-        lib.topo_validate.restype = ctypes.c_int32
-        lib.topo_chip_coords.argtypes = [ctypes.c_char_p, ctypes.c_int32,
-                                         i32p, i32p]
-        lib.topo_chip_coords.restype = ctypes.c_int32
-        lib.topo_host_ring.argtypes = [ctypes.c_char_p, i32p]
-        lib.topo_host_ring.restype = ctypes.c_int32
-        lib.combine_threshold_bytes.argtypes = [ctypes.c_int64,
-                                                ctypes.c_int32]
-        lib.combine_threshold_bytes.restype = ctypes.c_int64
-        _lib = lib
-    except (OSError, AttributeError) as e:
-        # AttributeError: symbol mismatch (old binary / changed ABI)
-        log.warning("failed to load %s: %s", _LIB_PATH, e)
-    return _lib
+    return _LIB.get()
 
 
 def topo_lookup(name: str) -> Optional[Tuple[int, int, int, int]]:

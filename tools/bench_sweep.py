@@ -1,0 +1,87 @@
+"""Operating-point sweep: run bench.py across configurations and bank
+the results as one artifact.
+
+Sweeps the perf-relevant axes the optimized chart exposes — ROIAlign
+backend (Pallas vs XLA), precision, remat — each as a separate
+``bench.py`` subprocess so a wedged/crashed configuration can't take
+the others down (the TPU tunnel serves one client at a time; runs are
+strictly sequential).
+
+Usage::
+
+    python tools/bench_sweep.py --out artifacts/bench_sweep_r2.json \
+        [--steps 20] [--quick] [--platform cpu]
+
+``--quick``: tiny shapes for a plumbing smoke on CPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+CONFIGS = [
+    # (name, extra argv) — first entry is the headline operating point
+    ("pallas_bf16", ["--roi-backend", "auto"]),
+    ("xla_bf16", ["--roi-backend", "xla"]),
+    ("pallas_bf16_remat", ["--roi-backend", "auto", "--remat"]),
+    ("pallas_f32", ["--roi-backend", "auto", "--precision", "float32"]),
+]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="artifacts/bench_sweep.json")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--timeout", type=float, default=1500,
+                   help="per-configuration wall clock budget (s)")
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+    for name, extra in CONFIGS:
+        cmd = [sys.executable, os.path.join(repo, "bench.py"),
+               "--steps", str(args.steps)] + extra
+        if args.platform:
+            cmd += ["--platform", args.platform]
+        if args.quick:
+            cmd += ["--image-size", "128", "--batch-size", "1",
+                    "--warmup", "1", "--config", "DATA.NUM_CLASSES=5",
+                    "DATA.MAX_GT_BOXES=8", "RPN.TRAIN_PRE_NMS_TOPK=64",
+                    "RPN.TRAIN_POST_NMS_TOPK=32", "FRCNN.BATCH_PER_IM=16",
+                    "FPN.NUM_CHANNEL=32", "FPN.FRCNN_FC_HEAD_DIM=64",
+                    "MRCNN.HEAD_DIM=16",
+                    "BACKBONE.RESNET_NUM_BLOCKS=(1,1,1,1)"]
+        t0 = time.time()
+        entry = {"config": name}
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=args.timeout, cwd=repo)
+            line = out.stdout.strip().splitlines()[-1] if out.stdout \
+                else ""
+            entry.update(json.loads(line))
+        except subprocess.TimeoutExpired:
+            entry["error"] = f"timeout after {args.timeout:.0f}s"
+        except (json.JSONDecodeError, IndexError):
+            entry["error"] = "no JSON line"
+            entry["stderr_tail"] = out.stderr.splitlines()[-3:]
+        entry["wall_s"] = round(time.time() - t0, 1)
+        results.append(entry)
+        print(f"{name}: "
+              f"{entry.get('value', entry.get('error'))}", file=sys.stderr)
+
+    payload = {"sweep": results}
+    print(json.dumps(payload))
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(payload, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
