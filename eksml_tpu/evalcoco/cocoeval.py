@@ -171,28 +171,39 @@ class COCOEvaluator:
         D, G = len(d_xywh), len(g_xywh)
         # sort gt: non-crowd first (pycocotools sorts by ignore flag)
         g_order = np.argsort(g_crowd, kind="mergesort")
-        dt_match = np.zeros((T, D), np.int64) - 1   # matched gt index
-        dt_crowd = np.zeros((T, D), bool)           # matched to crowd
-        gt_match = np.zeros((T, G), bool)
-        for t, thr in enumerate(IOU_THRESHS):
-            for di in range(D):
-                best = thr - 1e-10
-                best_g = -1
-                for gj in g_order:
-                    if gt_match[t, gj] and not g_crowd[gj]:
-                        continue
-                    # non-crowd match found; don't downgrade to crowd
-                    if best_g > -1 and not g_crowd[best_g] and g_crowd[gj]:
-                        break
-                    if ious[di, gj] < best:
-                        continue
-                    best = ious[di, gj]
-                    best_g = gj
-                if best_g >= 0:
-                    dt_match[t, di] = best_g
-                    dt_crowd[t, di] = bool(g_crowd[best_g])
-                    if not g_crowd[best_g]:
-                        gt_match[t, best_g] = True
+
+        native = None
+        if D and G:
+            from eksml_tpu.evalcoco.native import greedy_match_native
+
+            native = greedy_match_native(ious, g_crowd, g_order,
+                                         IOU_THRESHS)
+        if native is not None:
+            dt_match, dt_crowd, gt_match = native
+        else:
+            dt_match = np.zeros((T, D), np.int64) - 1   # matched gt idx
+            dt_crowd = np.zeros((T, D), bool)           # matched crowd
+            gt_match = np.zeros((T, G), bool)
+            for t, thr in enumerate(IOU_THRESHS):
+                for di in range(D):
+                    best = thr - 1e-10
+                    best_g = -1
+                    for gj in g_order:
+                        if gt_match[t, gj] and not g_crowd[gj]:
+                            continue
+                        # non-crowd match found; don't downgrade
+                        if (best_g > -1 and not g_crowd[best_g]
+                                and g_crowd[gj]):
+                            break
+                        if ious[di, gj] < best:
+                            continue
+                        best = ious[di, gj]
+                        best_g = gj
+                    if best_g >= 0:
+                        dt_match[t, di] = best_g
+                        dt_crowd[t, di] = bool(g_crowd[best_g])
+                        if not g_crowd[best_g]:
+                            gt_match[t, best_g] = True
         return {
             "score": d_score, "dt_match": dt_match, "dt_crowd": dt_crowd,
             "dt_area": d_xywh[:, 2] * d_xywh[:, 3],

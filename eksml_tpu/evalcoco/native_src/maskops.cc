@@ -9,6 +9,9 @@
 //   rle_encode_dense — dense mask → run-length counts (column-major,
 //                      pycocotools order)
 //   rle_iou         — IoU matrix over run-length encoded masks
+//   greedy_match    — per-threshold greedy det→gt matching (the
+//                     evaluateImg hot loop of pycocotools, a pure-
+//                     python triple loop in cocoeval.py otherwise)
 //
 // Build: make -C eksml_tpu/evalcoco/native_src   (g++ only, no deps)
 
@@ -125,6 +128,49 @@ void rle_iou(const uint32_t* det_counts, const int64_t* det_off,
       double uni = crowd[j] ? (double)det_area[i]
                             : (double)(det_area[i] + gt_area[j] - inter);
       out[i * n_gt + j] = uni > 0 ? (double)inter / uni : 0.0;
+    }
+  }
+}
+
+// Greedy score-ordered matching at T IoU thresholds — semantics of
+// cocoeval.py _evaluate_pair (pycocotools evaluateImg): detections in
+// score order each take the best still-available gt above threshold;
+// crowd gt never saturates and never displaces a non-crowd candidate.
+//   ious:     [D, G] double (crowd columns already IoF)
+//   g_order:  [G] int64 gt visit order (non-crowd first)
+//   threshs:  [T] double
+// Outputs: dt_match [T, D] int64 (matched gt index or -1),
+//          dt_crowd [T, D] uint8, gt_match [T, G] uint8.
+void greedy_match(const double* ious, int64_t D, int64_t G,
+                  const uint8_t* crowd, const int64_t* g_order,
+                  const double* threshs, int64_t T,
+                  int64_t* dt_match, uint8_t* dt_crowd,
+                  uint8_t* gt_match) {
+  for (int64_t t = 0; t < T; ++t) {
+    int64_t* dm = dt_match + t * D;
+    uint8_t* dc = dt_crowd + t * D;
+    uint8_t* gm = gt_match + t * G;
+    for (int64_t i = 0; i < D; ++i) dm[i] = -1;
+    std::memset(dc, 0, D);
+    std::memset(gm, 0, G);
+    for (int64_t di = 0; di < D; ++di) {
+      double best = threshs[t] - 1e-10;
+      int64_t best_g = -1;
+      for (int64_t k = 0; k < G; ++k) {
+        const int64_t gj = g_order[k];
+        if (gm[gj] && !crowd[gj]) continue;
+        // non-crowd match found; don't downgrade to crowd
+        if (best_g > -1 && !crowd[best_g] && crowd[gj]) break;
+        const double v = ious[di * G + gj];
+        if (v < best) continue;
+        best = v;
+        best_g = gj;
+      }
+      if (best_g >= 0) {
+        dm[di] = best_g;
+        dc[di] = crowd[best_g] ? 1 : 0;
+        if (!crowd[best_g]) gm[best_g] = 1;
+      }
     }
   }
 }
