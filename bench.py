@@ -159,6 +159,13 @@ def run(args, diag: dict) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
+    # persistent compile cache: the 1344-px train-step compile is
+    # minutes of XLA work over a flaky tunnel — pay it once, and the
+    # driver's round-end bench run then hits the cache
+    from eksml_tpu.utils.compile_cache import enable_persistent_cache
+
+    diag["compile_cache"] = enable_persistent_cache()
+
     import jax.numpy as jnp
     import numpy as np
     import optax

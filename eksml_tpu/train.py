@@ -381,6 +381,10 @@ def main(argv=None):
     platform = os.environ.get("EKSML_PLATFORM")
     if platform:
         jax.config.update("jax_platforms", platform)
+
+    from eksml_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     args = parse_args(argv)
 
     cfg = config_from_env(global_config)

@@ -1,0 +1,29 @@
+"""Persistent compilation cache (utils/compile_cache.py).
+
+The reference pays no compile cost (precompiled TF kernels); on TPU the
+train-step compile is minutes of XLA work, so the cache is part of the
+operational surface (bench.py, train.py, __graft_entry__.py enable it).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from eksml_tpu.utils.compile_cache import enable_persistent_cache
+
+
+def test_cache_populates(tmp_path, monkeypatch):
+    d = str(tmp_path / "cache")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", d)
+    assert enable_persistent_cache() == d
+
+    f = jax.jit(lambda x: x @ x.T + 1.0)
+    f(jnp.ones((32, 32))).block_until_ready()
+    assert os.listdir(d), "no cache entries written"
+
+
+def test_env_var_wins_over_argument(tmp_path, monkeypatch):
+    d = str(tmp_path / "env-cache")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", d)
+    assert enable_persistent_cache(str(tmp_path / "arg-cache")) == d
