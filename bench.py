@@ -100,6 +100,11 @@ def main(argv=None):
     # chart operating point: PREPROC.MAX_SIZE=1344 (config.py), the
     # shape the v5e-32 north star is defined at — NOT a smaller proxy
     p.add_argument("--image-size", type=int, default=1344)
+    p.add_argument("--pad-hw", type=int, nargs=2, default=None,
+                   metavar=("H", "W"),
+                   help="bench a rectangular PREPROC.BUCKETS canvas "
+                        "(e.g. 832 1344) instead of the square "
+                        "--image-size pad")
     p.add_argument("--precision", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--remat", action="store_true",
@@ -132,7 +137,8 @@ def main(argv=None):
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
         "batch_size": args.batch_size,
-        "image_size": args.image_size,
+        "image_size": (tuple(args.pad_hw) if args.pad_hw
+                       else args.image_size),
         "precision": args.precision,
         "roi_backend": args.roi_backend,
     }
@@ -175,12 +181,14 @@ def run(args, diag: dict) -> None:
     from eksml_tpu.models import MaskRCNN
     from eksml_tpu.train import make_optimizer
 
+    shape = tuple(args.pad_hw) if args.pad_hw else args.image_size
+    size = max(args.pad_hw) if args.pad_hw else args.image_size
     cfg.freeze(False)
     cfg.TRAIN.PRECISION = args.precision
     cfg.TRAIN.REMAT = args.remat
     cfg.TRAIN.BATCH_SIZE_PER_CHIP = args.batch_size
-    cfg.PREPROC.MAX_SIZE = args.image_size
-    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (args.image_size, args.image_size)
+    cfg.PREPROC.MAX_SIZE = size
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (size, size)
     cfg.update_args(args.config)
     cfg.freeze()
 
@@ -191,14 +199,14 @@ def run(args, diag: dict) -> None:
     diag["device_kind"] = dev_kind
     diag["n_devices"] = n_dev
     print(f"bench: {n_dev}x {dev_kind}, batch={args.batch_size}, "
-          f"image={args.image_size}, {args.precision}, "
+          f"image={shape}, {args.precision}, "
           f"roi={args.roi_backend}", file=sys.stderr)
 
     model = MaskRCNN.from_config(cfg)
     tx, _ = make_optimizer(cfg)
 
     batch = make_synthetic_batch(cfg, batch_size=args.batch_size,
-                                 image_size=args.image_size)
+                                 image_size=shape)
     batch = {k: jnp.asarray(v) for k, v in batch.items()
              if k not in ("image_scale", "image_id")}
 

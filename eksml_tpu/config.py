@@ -163,6 +163,13 @@ def _define_defaults() -> None:
     _C.PREPROC.TRAIN_SHORT_EDGE_SIZE = (800, 800)
     _C.PREPROC.TEST_SHORT_EDGE_SIZE = 800
     _C.PREPROC.MAX_SIZE = 1344     # multiple of 128: pad target H=W
+    # aspect-ratio bucketed padding: (H, W) canvases; each train image
+    # pads to the smallest bucket that holds it and every batch is
+    # bucket-homogeneous (one XLA program per bucket).  () = legacy
+    # square (MAX_SIZE, MAX_SIZE).  Dims must divide the coarsest FPN
+    # stride.  E.g. ((832, 1344), (1344, 832), (1344, 1344)) halves the
+    # padded-pixel count on typical landscape/portrait COCO images.
+    _C.PREPROC.BUCKETS = ()
     _C.PREPROC.PIXEL_MEAN = (123.675, 116.28, 103.53)
     _C.PREPROC.PIXEL_STD = (58.395, 57.12, 57.375)
 
@@ -277,6 +284,18 @@ def finalize_configs(is_training: bool) -> AttrDict:
     assert len(_C.FPN.ANCHOR_STRIDES) == len(_C.RPN.ANCHOR_SIZES)
     assert _C.PREPROC.MAX_SIZE % max(_C.FPN.ANCHOR_STRIDES) == 0, (
         "padded image size must be divisible by the coarsest FPN stride")
+    buckets = _C.PREPROC.BUCKETS or ()
+    if (len(buckets) == 2
+            and all(isinstance(b, int) for b in buckets)):
+        # PREPROC.BUCKETS=((832,1344)) parses as a flat 2-int tuple —
+        # the operator meant a single bucket
+        buckets = (tuple(buckets),)
+        _C.PREPROC.BUCKETS = buckets
+    for b in buckets:
+        assert isinstance(b, (tuple, list)) and len(b) == 2 and all(
+            int(d) % max(_C.FPN.ANCHOR_STRIDES) == 0 for d in b), (
+            f"bucket {b!r}: must be an (H, W) pair with dims divisible "
+            "by the coarsest FPN stride")
     if isinstance(_C.DATA.TRAIN, str):
         _C.DATA.TRAIN = (_C.DATA.TRAIN,)
 

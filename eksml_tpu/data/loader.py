@@ -19,28 +19,67 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from eksml_tpu.data.masks import polygons_to_bbox_mask, rle_decode
 
 
-def resize_and_pad(image: np.ndarray, short_edge: int, max_size: int):
+def _resized_hw(h: int, w: int, short_edge: int, max_size: int):
+    """(scale, nh, nw) of the standard resize: short edge to
+    ``short_edge``, long edge capped at ``max_size``.  Single source of
+    truth — ``assign_bucket``'s fit guarantee requires the exact same
+    rounding as ``resize_and_pad``."""
+    scale = short_edge / min(h, w)
+    if scale * max(h, w) > max_size:
+        scale = max_size / max(h, w)
+    return scale, int(round(h * scale)), int(round(w * scale))
+
+
+def resize_and_pad(image: np.ndarray, short_edge: int, max_size: int,
+                   pad_hw: Optional[Tuple[int, int]] = None):
     """Resize keeping aspect so short edge == short_edge (long edge
-    capped at max_size), then pad bottom/right to (max_size, max_size).
+    capped at max_size), then pad bottom/right to ``pad_hw`` (default
+    the legacy square ``(max_size, max_size)``).  When ``pad_hw`` is
+    tighter than the standard resize, the image is scaled further down
+    to fit (the bucket force-fit path).
 
     Returns (padded float32 image, scale, (new_h, new_w)).
     """
     h, w = image.shape[:2]
-    scale = short_edge / min(h, w)
-    if scale * max(h, w) > max_size:
-        scale = max_size / max(h, w)
-    nh, nw = int(round(h * scale)), int(round(w * scale))
+    scale, nh, nw = _resized_hw(h, w, short_edge, max_size)
+    if pad_hw is None:
+        pad_h = pad_w = max_size
+    else:
+        pad_h, pad_w = pad_hw
+        if scale > min(pad_h / h, pad_w / w):  # force-fit: shrink more
+            scale = min(pad_h / h, pad_w / w)
+            nh, nw = int(round(h * scale)), int(round(w * scale))
+    nh, nw = min(nh, pad_h), min(nw, pad_w)  # rounding guard
     resized = _bilinear_resize(image.astype(np.float32), nh, nw)
-    out = np.zeros((max_size, max_size, image.shape[2]), np.float32)
+    out = np.zeros((pad_h, pad_w, image.shape[2]), np.float32)
     out[:nh, :nw] = resized
     return out, scale, (nh, nw)
+
+
+def assign_bucket(h: int, w: int, short_edge: int, max_size: int,
+                  buckets) -> int:
+    """Index of the smallest-area bucket that holds ``(h, w)`` resized
+    at ``short_edge`` (long edge capped at ``max_size``); falls back to
+    the largest-area bucket (force-fit: extra scale-down) if none fit.
+
+    ``buckets`` must be sorted by area ascending (DetectionLoader
+    normalizes them).  Using the *maximum* short-edge draw makes the
+    assignment an upper bound over the per-example random short edge,
+    so a record's bucket is draw-independent — the property the
+    cross-host bucket schedule relies on.
+    """
+    _, nh, nw = _resized_hw(h, w, short_edge, max_size)
+    for i, (bh, bw) in enumerate(buckets):
+        if nh <= bh and nw <= bw:
+            return i
+    return len(buckets) - 1
 
 
 def _bilinear_resize(img: np.ndarray, nh: int, nw: int) -> np.ndarray:
@@ -132,6 +171,56 @@ class DetectionLoader:
         self.num_workers = num_workers
         self._order = np.arange(len(self.records))
         self._pos = 0
+        self._init_buckets(records, cfg, seed)
+
+    # -- aspect-ratio buckets ------------------------------------------
+
+    def _init_buckets(self, all_records: List[Dict], cfg, seed: int):
+        """Aspect-ratio bucketed padding (PREPROC.BUCKETS).
+
+        Square padding wastes ~2× compute on typical landscape COCO
+        images (a 640×480 image resizes to 1067×800 but pads to
+        1344×1344).  With buckets, each image pads only to the smallest
+        configured (H, W) canvas that holds it, and every batch is
+        bucket-homogeneous — XLA compiles one program per bucket and
+        the MXU stops convolving zeros.
+
+        Multi-host contract (SURVEY.md §7 hard part #4): in SPMD every
+        host must run the *same* compiled program each step, so the
+        bucket sequence is drawn from a schedule RNG seeded WITHOUT
+        host_id, with choice probabilities computed from the full
+        pre-shard record list — identical on every host.  A host whose
+        shard lacks records of the scheduled bucket force-fits records
+        from its general pool (rare, only under extreme shard skew).
+        """
+        buckets = tuple(getattr(cfg.PREPROC, "BUCKETS", ()) or ())
+        self.bucket_mode = bool(buckets) and self.is_training
+        if not self.bucket_mode:
+            return
+        # sort by area so assign_bucket's first fit is the tightest
+        self.buckets: List[Tuple[int, int]] = sorted(
+            (tuple(int(x) for x in b) for b in buckets),
+            key=lambda b: b[0] * b[1])
+        short_max = max(cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE)
+        max_size = cfg.PREPROC.MAX_SIZE
+
+        def bucket_of(rec):
+            return assign_bucket(rec["height"], rec["width"], short_max,
+                                 max_size, self.buckets)
+
+        # choice probabilities from the FULL list: every host computes
+        # the same numbers regardless of its shard
+        counts = np.zeros(len(self.buckets), np.float64)
+        for rec in all_records:
+            counts[bucket_of(rec)] += 1
+        self.bucket_freqs = counts / counts.sum()
+        self._sched_rng = np.random.RandomState(seed)  # no host_id!
+        # per-bucket index cycles over the local shard
+        self._bucket_orders = [
+            np.asarray([i for i, rec in enumerate(self.records)
+                        if bucket_of(rec) == b], np.int64)
+            for b in range(len(self.buckets))]
+        self._bucket_pos = [0] * len(self.buckets)
 
     # -- single example -----------------------------------------------
 
@@ -144,8 +233,9 @@ class DetectionLoader:
         do_flip = self.is_training and bool(self.rng.rand() < 0.5)
         return short, do_flip
 
-    def _load_example(self, rec: Dict, short: int,
-                      do_flip: bool) -> Dict[str, np.ndarray]:
+    def _load_example(self, rec: Dict, short: int, do_flip: bool,
+                      pad_hw: Optional[Tuple[int, int]] = None
+                      ) -> Dict[str, np.ndarray]:
         if rec.get("_image") is not None:
             image = rec["_image"]
         else:
@@ -162,7 +252,8 @@ class DetectionLoader:
         segs = [rec["segmentation"][i] for i in order]
 
         max_size = self.cfg.PREPROC.MAX_SIZE
-        image_f, scale, (nh, nw) = resize_and_pad(image, short, max_size)
+        image_f, scale, (nh, nw) = resize_and_pad(image, short, max_size,
+                                                  pad_hw)
         boxes = boxes * scale
 
         if do_flip:
@@ -245,6 +336,27 @@ class DetectionLoader:
             self._pos = (self._pos + 1) % len(self._order)
         return out
 
+    def _next_bucket_batch(self) -> Tuple[Optional[Tuple[int, int]],
+                                          List[int]]:
+        """(pad_hw, indices) for one batch.  In bucket mode the bucket
+        comes from the shared schedule RNG (identical across hosts);
+        indices cycle the host-local per-bucket order, falling back to
+        the general cycle (force-fit) when the shard has none."""
+        if not self.bucket_mode:
+            return None, self._next_indices()
+        b = int(self._sched_rng.choice(len(self.buckets),
+                                       p=self.bucket_freqs))
+        order = self._bucket_orders[b]
+        if len(order) == 0:
+            return self.buckets[b], self._next_indices()
+        out = []
+        for _ in range(self.batch_size):
+            if self._bucket_pos[b] == 0:
+                self.rng.shuffle(order)
+            out.append(int(order[self._bucket_pos[b]]))
+            self._bucket_pos[b] = (self._bucket_pos[b] + 1) % len(order)
+        return self.buckets[b], out
+
     def batches(self, num_steps: Optional[int] = None
                 ) -> Iterator[Dict[str, np.ndarray]]:
         """Yield ``num_steps`` batches (wrap-around; infinite if None)
@@ -276,15 +388,16 @@ class DetectionLoader:
             try:
                 while not stop.is_set() and (num_steps is None
                                              or produced < num_steps):
-                    idx = self._next_indices()
+                    pad_hw, idx = self._next_bucket_batch()
                     recs = [self.records[i] for i in idx]
                     draws = [self._draw() for _ in idx]
                     if pool is not None:
                         exs = list(pool.map(
                             self._load_example, recs,
-                            [d[0] for d in draws], [d[1] for d in draws]))
+                            [d[0] for d in draws], [d[1] for d in draws],
+                            [pad_hw] * len(recs)))
                     else:
-                        exs = [self._load_example(r, s, f)
+                        exs = [self._load_example(r, s, f, pad_hw)
                                for r, (s, f) in zip(recs, draws)]
                     batch = {k: np.stack([e[k] for e in exs])
                              for k in exs[0].keys()}
@@ -323,22 +436,32 @@ def _crop_resize_binary(mask: np.ndarray, box, out_size: int) -> np.ndarray:
     return mask[np.ix_(ys, xs)]
 
 
-def make_synthetic_batch(cfg, batch_size: int = 1, image_size: int = 256,
+def make_synthetic_batch(cfg, batch_size: int = 1, image_size=256,
                          seed: int = 0, with_masks: bool = True,
                          gt_mask_size: int = 56) -> Dict[str, np.ndarray]:
-    """One fixed batch for tests/bench/compile-checks."""
-    ds = SyntheticDataset(num_images=batch_size * 2, height=image_size,
-                          width=image_size,
+    """One fixed batch for tests/bench/compile-checks.
+
+    ``image_size``: int for a square pad, or ``(H, W)`` to produce a
+    rectangular bucket batch (benching PREPROC.BUCKETS shapes)."""
+    if isinstance(image_size, int):
+        hw = (image_size, image_size)
+    else:
+        hw = (int(image_size[0]), int(image_size[1]))
+    ds = SyntheticDataset(num_images=batch_size * 2, height=hw[0],
+                          width=hw[1],
                           num_classes=cfg.DATA.NUM_CLASSES, seed=seed)
-    saved = cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE
+    saved = (cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE,
+             cfg.PREPROC.BUCKETS)
     cfg.freeze(False)
-    cfg.PREPROC.MAX_SIZE = image_size
-    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (image_size, image_size)
+    cfg.PREPROC.MAX_SIZE = max(hw)
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (min(hw), min(hw))
+    cfg.PREPROC.BUCKETS = (hw,) if hw[0] != hw[1] else ()
     try:
         loader = DetectionLoader(ds.records(), cfg, batch_size,
                                  with_masks=with_masks, seed=seed,
                                  gt_mask_size=gt_mask_size, prefetch=1)
         return next(iter(loader.batches(1)))
     finally:
-        cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = saved
+        (cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE,
+         cfg.PREPROC.BUCKETS) = saved
         cfg.freeze()

@@ -37,6 +37,15 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         warnings.warn(f"persistent compile cache disabled: {e}",
                       stacklevel=2)
         return None
+    try:
+        # if compiles already happened in this process, the cache object
+        # latched its (possibly disabled) state — reset so the new dir
+        # takes effect mid-process
+        from jax._src.compilation_cache import reset_cache
+
+        reset_cache()
+    except Exception:  # noqa: BLE001 — private API, best-effort
+        pass
     # cache everything: tiny entries are free, and the expensive ones
     # (train step at 1344 px) are exactly what we must not recompile
     # over a flaky tunnel.  Threshold flags are best-effort: the cache

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Patiently retry bench.py until a real throughput number lands.
+#
+# The axon TPU tunnel serializes clients and a client killed mid-compile
+# wedges the server for a long time (observed round 1 and round 2) — so
+# this loop (a) waits for any already-running bench to finish instead of
+# racing it, (b) gives each attempt a very generous deadline so we never
+# kill a compile in progress, and (c) backs off between attempts.
+# First success writes the JSON line to BENCH_LOCAL.json and exits; the
+# persistent compile cache makes every later bench run (incl. the
+# driver's round-end one) fast.
+set -u
+cd "$(dirname "$0")/.."
+ATTEMPTS=${ATTEMPTS:-12}
+PER_RUN_TIMEOUT=${PER_RUN_TIMEOUT:-7200}
+for i in $(seq 1 "$ATTEMPTS"); do
+    while pgrep -f "python bench.py" >/dev/null 2>&1; do sleep 60; done
+    echo "[loop] attempt $i/$ATTEMPTS $(date -u +%H:%M:%S)" >> bench_loop.log
+    out=$(timeout "$PER_RUN_TIMEOUT" python bench.py --steps 20 \
+        --init-retries 3 --init-timeout 300 2>>bench_loop.log | tail -1)
+    echo "$out" >> bench_attempts.jsonl
+    if echo "$out" | python - <<'EOF'
+import json, sys
+try:
+    d = json.loads(sys.stdin.read())
+except Exception:
+    sys.exit(1)
+sys.exit(0 if d.get("value", 0) > 0 else 1)
+EOF
+    then
+        echo "$out" > BENCH_LOCAL.json
+        echo "[loop] success on attempt $i" >> bench_loop.log
+        exit 0
+    fi
+    sleep 300
+done
+echo "[loop] exhausted $ATTEMPTS attempts" >> bench_loop.log
+exit 1
