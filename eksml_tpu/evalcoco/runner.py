@@ -135,25 +135,68 @@ def run_evaluation(model, params, cfg, records: List[Dict],
     shard = records[host_id::num_hosts]
     by_id = {rec["image_id"]: rec for rec in records}
 
-    # every host must run the same number of batches: pad with repeats,
-    # marked invalid via image_id -1 so their detections are dropped
-    per_host = max((len(records) + num_hosts - 1) // num_hosts, 1)
-    n_batches = (per_host + batch_size - 1) // batch_size
-    padded = list(shard) + [None] * (n_batches * batch_size - len(shard))
-
-    if predict_fn is None:
-        predict_fn = make_predict_fn(model)
-
     max_size = cfg.PREPROC.MAX_SIZE
     short = cfg.PREPROC.TEST_SHORT_EDGE_SIZE
     mean = np.asarray(cfg.PREPROC.PIXEL_MEAN, np.float32)
     std = np.asarray(cfg.PREPROC.PIXEL_STD, np.float32)
 
+    if num_hosts > 1 and params is not None:
+        # Localize params to this host before predicting.  Training
+        # hands us mesh-REPLICATED global arrays; jit over those forms
+        # a multi-process global computation, which would require every
+        # host to issue identical programs in identical order — the
+        # bucketed plan below runs per-host counts/orders.  Replicated
+        # arrays are fully addressable, so np.asarray is a local read;
+        # the re-put lands on this host's devices only.
+        params = jax.tree.map(np.asarray, params)
+
+    # batch plan: [(canvas_hw, [rec|None, ...]), ...].  With
+    # PREPROC.BUCKETS the shard is grouped by canvas so each batch pads
+    # to its group's (H, W) (~2x fewer padded pixels, one compiled
+    # predict program per canvas).  A record that fits no bucket at
+    # test resolution goes to an implicit square (max_size, max_size)
+    # canvas — eval NEVER downscales below the configured test
+    # resolution (unlike training's force-fit).
+    buckets = tuple(sorted(
+        (tuple(int(x) for x in b) for b in (cfg.PREPROC.BUCKETS or ())),
+        key=lambda b: b[0] * b[1]))
+    plan = []
+    if buckets:
+        from eksml_tpu.data.loader import _resized_hw
+
+        groups: Dict[tuple, List] = {}
+        for rec in shard:
+            _, nh, nw = _resized_hw(rec["height"], rec["width"], short,
+                                    max_size)
+            canvas = next((b for b in buckets
+                           if nh <= b[0] and nw <= b[1]),
+                          (max_size, max_size))
+            groups.setdefault(canvas, []).append(rec)
+        for canvas in sorted(groups):
+            grp = groups[canvas]
+            for o in range(0, len(grp), batch_size):
+                chunk = grp[o:o + batch_size]
+                chunk += [None] * (batch_size - len(chunk))
+                plan.append((canvas, chunk))
+    else:
+        # every host runs the same number of batches: pad with rows
+        # marked invalid via image_id -1 so their detections drop
+        per_host = max((len(records) + num_hosts - 1) // num_hosts, 1)
+        n_batches = (per_host + batch_size - 1) // batch_size
+        padded = list(shard) + [None] * (n_batches * batch_size
+                                         - len(shard))
+        plan = [((max_size, max_size),
+                 padded[b * batch_size:(b + 1) * batch_size])
+                for b in range(n_batches)]
+
+    if predict_fn is None:
+        predict_fn = make_predict_fn(model)
+
     from eksml_tpu.data.coco import load_image
 
     def build_batch(b: int):
-        chunk = padded[b * batch_size:(b + 1) * batch_size]
-        images = np.zeros((batch_size, max_size, max_size, 3), np.float32)
+        canvas, chunk = plan[b]
+        images = np.zeros((batch_size,) + canvas + (3,), np.float32)
         hw = np.ones((batch_size, 2), np.float32)
         scales = np.ones(batch_size, np.float32)
         ids = np.full(batch_size, -1, np.int64)
@@ -162,16 +205,18 @@ def run_evaluation(model, params, cfg, records: List[Dict],
                 continue
             img = (rec["_image"] if rec.get("_image") is not None
                    else load_image(rec["path"]))
-            im, scale, (nh, nw) = resize_and_pad(img, short, max_size)
+            im, scale, (nh, nw) = resize_and_pad(img, short, max_size,
+                                                 pad_hw=canvas)
             images[i] = (im - mean) / std
             hw[i] = (nh, nw)
             scales[i] = scale
             ids[i] = rec["image_id"]
         return images, hw, scales, ids
 
+    n_batches = len(plan)  # 0 possible: empty shard in bucket mode
     host_dets = []  # per-image: original-coord boxes/scores/classes(+RLEs)
     with ThreadPoolExecutor(max_workers=1) as pool:
-        nxt = pool.submit(build_batch, 0)
+        nxt = pool.submit(build_batch, 0) if n_batches else None
         for b in range(n_batches):
             images, hw, scales, ids = nxt.result()
             if b + 1 < n_batches:

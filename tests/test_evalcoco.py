@@ -215,3 +215,91 @@ def test_native_greedy_match_matches_python():
                                       err_msg=f"trial {trial}")
         np.testing.assert_array_equal(native[1], dt_crowd)
         np.testing.assert_array_equal(native[2], gt_match)
+
+
+def test_run_evaluation_bucketed():
+    """Bucketed eval path: the shard is grouped by PREPROC.BUCKETS
+    canvas, batches pad to the rectangular canvas, detections still
+    round-trip to original coordinates (AP 1.0 with a GT stub).
+
+    The stub keys records off the batch's (nh, nw) rows — record sizes
+    are distinct so content dims identify the image.
+    """
+    import jax.numpy as jnp
+
+    from eksml_tpu.config import config as cfg
+    from eksml_tpu.data.loader import SyntheticDataset
+    from eksml_tpu.evalcoco.runner import run_evaluation
+
+    d = 8
+    sizes = [(48, 64), (40, 64), (64, 48)]  # 2 landscape + 1 portrait
+    records = []
+    for i, (h, w) in enumerate(sizes):
+        r = SyntheticDataset(num_images=1, height=h, width=w,
+                             max_boxes=3, num_classes=5,
+                             seed=10 + i).records()[0]
+        r = dict(r)
+        r["image_id"] = i
+        records.append(r)
+    by_hw = {(r["height"], r["width"]): r for r in records}
+
+    saved = (cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TEST_SHORT_EDGE_SIZE,
+             cfg.PREPROC.BUCKETS, cfg.TEST.RESULTS_PER_IM)
+    cfg.freeze(False)
+    cfg.PREPROC.MAX_SIZE = 64
+    cfg.PREPROC.TEST_SHORT_EDGE_SIZE = 64  # scale 1 at these sizes
+    cfg.PREPROC.BUCKETS = ((64, 64), (48, 64), (64, 48))
+    cfg.TEST.RESULTS_PER_IM = d
+    cfg.freeze()
+
+    seen_shapes = set()
+
+    def stub_predict(params, images, hw):
+        b = images.shape[0]
+        seen_shapes.add(tuple(images.shape[1:3]))
+        boxes = np.zeros((b, d, 4), np.float32)
+        scores = np.zeros((b, d), np.float32)
+        classes = np.zeros((b, d), np.int32)
+        valid = np.zeros((b, d), np.float32)
+        masks = np.zeros((b, d, 28, 28), np.float32)
+        for i in range(b):
+            key = (int(hw[i, 0]), int(hw[i, 1]))
+            rec = by_hw.get(key)
+            if rec is None:
+                continue  # padding row
+            n = len(rec["boxes"])
+            boxes[i, :n] = rec["boxes"]
+            scores[i, :n] = 0.9
+            classes[i, :n] = rec["classes"]
+            valid[i, :n] = 1.0
+            masks[i, :n] = 1.0
+        return {"boxes": jnp.asarray(boxes), "scores": jnp.asarray(scores),
+                "classes": jnp.asarray(classes),
+                "valid": jnp.asarray(valid), "masks": jnp.asarray(masks)}
+
+    try:
+        res = run_evaluation(None, None, cfg, records, batch_size=2,
+                             predict_fn=stub_predict)
+        bucket_shapes = set(seen_shapes)
+        # identical run on the legacy square pad: the bucketed path
+        # must reproduce its APs exactly (segm AP < 1 here is shared
+        # paste-vs-GT rounding, not a bucketing artifact)
+        cfg.freeze(False)
+        cfg.PREPROC.BUCKETS = ()
+        cfg.freeze()
+        seen_shapes.clear()
+        res_sq = run_evaluation(None, None, cfg, records, batch_size=2,
+                                predict_fn=stub_predict)
+    finally:
+        cfg.freeze(False)
+        (cfg.PREPROC.MAX_SIZE, cfg.PREPROC.TEST_SHORT_EDGE_SIZE,
+         cfg.PREPROC.BUCKETS, cfg.TEST.RESULTS_PER_IM) = saved
+        cfg.freeze()
+
+    assert res["bbox/AP"] == pytest.approx(1.0, abs=1e-6)
+    assert res["segm/AP"] == pytest.approx(res_sq["segm/AP"], abs=1e-6)
+    assert res["bbox/AP"] == pytest.approx(res_sq["bbox/AP"], abs=1e-6)
+    # both rectangular canvases actually used; square never needed
+    assert (48, 64) in bucket_shapes and (64, 48) in bucket_shapes
+    assert (64, 64) not in bucket_shapes
+    assert seen_shapes == {(64, 64)}

@@ -132,6 +132,48 @@ if pid == 0:
 else:
     assert res == {}, res
 
+# bucketed distributed eval: per-host canvas plans legitimately differ
+# (host 0's shard: 3 imgs over 2 canvases; host 1's: 2 imgs over 2,
+# incl. the implicit square fallback) — prediction is host-local, so
+# mismatched plans must still gather to AP 1.0 on the coordinator
+sizes = [(48, 64), (40, 64), (64, 48), (64, 64), (32, 64)]
+brecords = []
+for i, (h, w) in enumerate(sizes):
+    r = SyntheticDataset(num_images=1, height=h, width=w, max_boxes=3,
+                         num_classes=5, seed=20 + i).records()[0]
+    r = dict(r)
+    r["image_id"] = 50 + i
+    brecords.append(r)
+by_hw = {(r["height"], r["width"]): r for r in brecords}
+cfg.freeze(False)
+cfg.PREPROC.BUCKETS = ((48, 64), (64, 48))
+cfg.freeze()
+
+def stub_predict_b(params, images, hw):
+    b = images.shape[0]
+    boxes = np.zeros((b, d, 4), np.float32)
+    scores = np.zeros((b, d), np.float32)
+    classes = np.zeros((b, d), np.int32)
+    valid = np.zeros((b, d), np.float32)
+    for i in range(b):
+        rec = by_hw.get((int(hw[i, 0]), int(hw[i, 1])))
+        if rec is None:
+            continue  # padding row
+        n = len(rec["boxes"])
+        boxes[i, :n] = rec["boxes"]
+        scores[i, :n] = 0.9
+        classes[i, :n] = rec["classes"]
+        valid[i, :n] = 1.0
+    import jax.numpy as _jnp
+    return {"boxes": _jnp.asarray(boxes), "scores": _jnp.asarray(scores),
+            "classes": _jnp.asarray(classes), "valid": _jnp.asarray(valid)}
+
+res = run_evaluation(None, None, cfg, brecords, predict_fn=stub_predict_b)
+if pid == 0:
+    assert abs(res["bbox/AP"] - 1.0) < 1e-6, res
+else:
+    assert res == {}, res
+
 print(f"worker {pid} OK", flush=True)
 """
 

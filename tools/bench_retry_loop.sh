@@ -19,14 +19,13 @@ for i in $(seq 1 "$ATTEMPTS"); do
     out=$(timeout "$PER_RUN_TIMEOUT" python bench.py --steps 20 \
         --init-retries 3 --init-timeout 300 2>>bench_loop.log | tail -1)
     echo "$out" >> bench_attempts.jsonl
-    if echo "$out" | python - <<'EOF'
+    if python -c '
 import json, sys
 try:
-    d = json.loads(sys.stdin.read())
+    d = json.loads(sys.argv[1])
 except Exception:
     sys.exit(1)
-sys.exit(0 if d.get("value", 0) > 0 else 1)
-EOF
+sys.exit(0 if d.get("value", 0) > 0 else 1)' "$out"
     then
         echo "$out" > BENCH_LOCAL.json
         echo "[loop] success on attempt $i" >> bench_loop.log

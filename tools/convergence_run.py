@@ -27,6 +27,10 @@ import sys
 import tempfile
 import time
 
+# runnable as `python tools/convergence_run.py` from anywhere: the repo
+# root (eksml_tpu, tools) may not be on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main(argv=None):
     p = argparse.ArgumentParser()
