@@ -7,9 +7,11 @@ compute box/mask AP, surface the scalars to TensorBoard.
 
 Distributed protocol (SURVEY.md §7 hard part #5 — the reference gets
 this free from single-rank eval): every host predicts its shard of the
-val set with the SAME number of batches (shards are padded, padding
-rows carry image_id -1), detections are all-gathered as fixed-shape
-arrays, and the coordinator runs the accumulate step.
+val set with host-LOCAL jit (params localized first), so per-host
+batch counts and canvas shapes are free to differ (they do under
+PREPROC.BUCKETS); the only collective is the final detection gather,
+which every host enters exactly once.  Padding rows carry image_id -1.
+Do NOT add per-batch cross-host collectives to the predict loop.
 """
 
 from __future__ import annotations
@@ -22,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from eksml_tpu.data.loader import resize_and_pad
+from eksml_tpu.data.loader import quantize_uint8, resize_and_pad
 from eksml_tpu.data.masks import paste_mask, polygon_fill, rle_decode, \
     rle_encode
 
@@ -107,8 +109,9 @@ def run_evaluation(model, params, cfg, records: List[Dict],
 
     Production shape (VERDICT r1 item 4):
     - every host predicts records[host_id::num_hosts] in batches of
-      ``TEST.EVAL_BATCH_SIZE`` (identical batch counts per host —
-      shards padded with image_id -1 rows);
+      ``TEST.EVAL_BATCH_SIZE`` with host-local jit; per-host batch
+      counts may differ (bucket mode) — only the final gather is
+      collective;
     - the NEXT batch's images are loaded/resized on a worker thread
     while the TPU predicts the current one;
     - each host pastes + RLE-encodes ITS OWN images' masks, so the
@@ -194,9 +197,12 @@ def run_evaluation(model, params, cfg, records: List[Dict],
 
     from eksml_tpu.data.coco import load_image
 
+    device_norm = bool(getattr(cfg.PREPROC, "DEVICE_NORMALIZE", False))
+
     def build_batch(b: int):
         canvas, chunk = plan[b]
-        images = np.zeros((batch_size,) + canvas + (3,), np.float32)
+        images = np.zeros((batch_size,) + canvas + (3,),
+                          np.uint8 if device_norm else np.float32)
         hw = np.ones((batch_size, 2), np.float32)
         scales = np.ones(batch_size, np.float32)
         ids = np.full(batch_size, -1, np.int64)
@@ -207,7 +213,10 @@ def run_evaluation(model, params, cfg, records: List[Dict],
                    else load_image(rec["path"]))
             im, scale, (nh, nw) = resize_and_pad(img, short, max_size,
                                                  pad_hw=canvas)
-            images[i] = (im - mean) / std
+            if device_norm:  # model folds (x-mean)/std into the program
+                images[i] = quantize_uint8(im)
+            else:
+                images[i] = (im - mean) / std
             hw[i] = (nh, nw)
             scales[i] = scale
             ids[i] = rec["image_id"]

@@ -68,6 +68,9 @@ class MaskRCNN(nn.Module):
     test_nms_thresh: float = 0.5
     test_score_thresh: float = 0.05
     test_results_per_im: int = 100
+    # on-device normalization constants (used only for uint8 inputs)
+    pixel_mean: Tuple[float, ...] = (123.675, 116.28, 103.53)
+    pixel_std: Tuple[float, ...] = (58.395, 57.12, 57.375)
     compute_dtype: Any = jnp.float32
     # remat backbone/FPN activations (TRAIN.REMAT): recomputed in the
     # backward pass, freeing the largest activation tensors from HBM
@@ -109,6 +112,8 @@ class MaskRCNN(nn.Module):
             test_nms_thresh=cfg.TEST.FRCNN_NMS_THRESH,
             test_score_thresh=cfg.TEST.RESULT_SCORE_THRESH,
             test_results_per_im=cfg.TEST.RESULTS_PER_IM,
+            pixel_mean=tuple(cfg.PREPROC.PIXEL_MEAN),
+            pixel_std=tuple(cfg.PREPROC.PIXEL_STD),
             compute_dtype=(jnp.bfloat16 if cfg.TRAIN.PRECISION == "bfloat16"
                            else jnp.float32),
             remat=cfg.TRAIN.REMAT,
@@ -156,8 +161,18 @@ class MaskRCNN(nn.Module):
         bf16 through ROIAlign and the heads — halving the HBM traffic
         of the gather path and keeping head matmuls on the bf16 MXU;
         every head casts its own outputs back to f32, so losses,
-        proposal decoding and NMS run at full precision."""
-        x = images.astype(self.compute_dtype)
+        proposal decoding and NMS run at full precision.
+
+        uint8 input = PREPROC.DEVICE_NORMALIZE: the host ships raw
+        bytes (4x less H2D traffic) and (x-mean)/std runs here, fused
+        by XLA into the first conv.  Float input is assumed already
+        normalized (legacy path)."""
+        x = images
+        if x.dtype == jnp.uint8:
+            mean = jnp.asarray(self.pixel_mean, jnp.float32)
+            std = jnp.asarray(self.pixel_std, jnp.float32)
+            x = (x.astype(jnp.float32) - mean) / std
+        x = x.astype(self.compute_dtype)
         c_feats = self.backbone(x)
         return self.fpn(c_feats)  # P2..P6
 

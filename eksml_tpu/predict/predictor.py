@@ -88,6 +88,12 @@ class OfflinePredictor:
         im, scale, (nh, nw) = resize_and_pad(
             image, self.cfg.PREPROC.TEST_SHORT_EDGE_SIZE,
             self.cfg.PREPROC.MAX_SIZE)
+        if getattr(self.cfg.PREPROC, "DEVICE_NORMALIZE", False):
+            # uint8 in; the model normalizes on device (same compiled
+            # program the eval runner uses)
+            from eksml_tpu.data.loader import quantize_uint8
+
+            return quantize_uint8(im), scale, (nh, nw)
         return (im - self.mean) / self.std, scale, (nh, nw)
 
     def __call__(self, image: np.ndarray,

@@ -260,3 +260,34 @@ def test_native_resize_matches_numpy():
         ref = rows[:, x0] * (1 - lx) + rows[:, x1] * lx
         np.testing.assert_allclose(out, ref, atol=1e-3,
                                    err_msg=f"{nh}x{nw}")
+
+
+def test_device_normalize_batches_are_uint8(fresh_config):
+    """PREPROC.DEVICE_NORMALIZE ships raw bytes; values are the rounded
+    resize output of the f32 path, padding stays zero."""
+    cfg = fresh_config
+    cfg.PREPROC.MAX_SIZE = 128
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (128, 128)
+    cfg.DATA.MAX_GT_BOXES = 8
+    cfg.DATA.NUM_WORKERS = 0
+    cfg.PREPROC.DEVICE_NORMALIZE = True
+
+    ds = SyntheticDataset(num_images=2, height=100, width=140)
+    u8 = next(iter(DetectionLoader(ds.records(), cfg, 2, seed=5,
+                                   prefetch=1).batches(1)))
+    assert u8["images"].dtype == np.uint8
+
+    cfg.PREPROC.DEVICE_NORMALIZE = False
+    f32 = next(iter(DetectionLoader(ds.records(), cfg, 2, seed=5,
+                                    prefetch=1).batches(1)))
+    assert f32["images"].dtype == np.float32
+
+    mean = np.asarray(cfg.PREPROC.PIXEL_MEAN, np.float32)
+    std = np.asarray(cfg.PREPROC.PIXEL_STD, np.float32)
+    raw = f32["images"] * std + mean  # undo host normalization
+    np.testing.assert_allclose(u8["images"].astype(np.float32),
+                               np.clip(np.round(raw), 0, 255), atol=0.51)
+    # padding region (beyond content) is zero bytes
+    nh, nw = int(u8["image_hw"][0, 0]), int(u8["image_hw"][0, 1])
+    assert nh < 128  # 100x140 -> 91x128: rows pad
+    assert u8["images"][0, nh:].max() == 0

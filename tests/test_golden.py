@@ -31,6 +31,9 @@ def test_training_losses_match_golden(fresh_config):
     cfg = fresh_config
     cfg.PREPROC.MAX_SIZE = 128
     cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (128, 128)
+    # goldens were banked on the host-normalized f32 pipeline; the
+    # uint8 device-normalize path is covered by its own parity test
+    cfg.PREPROC.DEVICE_NORMALIZE = False
     cfg.DATA.MAX_GT_BOXES = 8
     cfg.RPN.TRAIN_PRE_NMS_TOPK = 64
     cfg.RPN.TRAIN_POST_NMS_TOPK = 32
@@ -52,3 +55,45 @@ def test_training_losses_match_golden(fresh_config):
     for k, want in GOLDEN.items():
         got = float(losses[k])
         assert got == pytest.approx(want, abs=2e-3), (k, got, want)
+
+
+@pytest.mark.slow
+def test_device_normalize_matches_host_normalize(fresh_config):
+    """uint8 batch + on-device (x-mean)/std must reproduce the f32
+    host-normalized losses up to quantization (<0.5/255 of range)."""
+    cfg = fresh_config
+    cfg.PREPROC.MAX_SIZE = 128
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (128, 128)
+    cfg.DATA.MAX_GT_BOXES = 8
+    cfg.RPN.TRAIN_PRE_NMS_TOPK = 64
+    cfg.RPN.TRAIN_POST_NMS_TOPK = 32
+    cfg.FRCNN.BATCH_PER_IM = 16
+    cfg.FPN.NUM_CHANNEL = 32
+    cfg.FPN.FRCNN_FC_HEAD_DIM = 64
+    cfg.MRCNN.HEAD_DIM = 16
+    cfg.BACKBONE.RESNET_NUM_BLOCKS = (1, 1, 1, 1)
+
+    cfg.PREPROC.DEVICE_NORMALIZE = False
+    cfg.freeze()
+    model = MaskRCNN.from_config(cfg)
+    batch = make_synthetic_batch(cfg, batch_size=1, image_size=128,
+                                 seed=7, gt_mask_size=28)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+    rng = jax.random.PRNGKey(42)
+    params = model.init(rng, batch, rng)["params"]
+    losses_f32 = model.apply({"params": params}, batch, rng)
+
+    cfg.freeze(False)
+    cfg.PREPROC.DEVICE_NORMALIZE = True
+    cfg.freeze()
+    batch_u8 = make_synthetic_batch(cfg, batch_size=1, image_size=128,
+                                    seed=7, gt_mask_size=28)
+    batch_u8 = {k: jnp.asarray(v) for k, v in batch_u8.items()
+                if k not in ("image_scale", "image_id")}
+    assert batch_u8["images"].dtype == jnp.uint8
+    losses_u8 = model.apply({"params": params}, batch_u8, rng)
+
+    for k in losses_f32:
+        a, b = float(losses_f32[k]), float(losses_u8[k])
+        assert a == pytest.approx(b, abs=5e-3), (k, a, b)

