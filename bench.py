@@ -183,6 +183,12 @@ def run(args, diag: dict) -> None:
 
     shape = tuple(args.pad_hw) if args.pad_hw else args.image_size
     size = max(args.pad_hw) if args.pad_hw else args.image_size
+    for d in (args.pad_hw or [args.image_size]):
+        if d % 64:
+            raise ValueError(
+                f"pad dim {d} must be divisible by the coarsest FPN "
+                "stride (64): anchor grids are computed at H//stride "
+                "and must match the conv feature maps")
     cfg.freeze(False)
     cfg.TRAIN.PRECISION = args.precision
     cfg.TRAIN.REMAT = args.remat

@@ -1,0 +1,98 @@
+"""Fault injection: SIGKILL a live trainer, relaunch, assert resume.
+
+SURVEY.md §5.3: the reference has NO fault injection anywhere and
+restartPolicy Never — a dead rank means rerun by hand.  Our contract
+is JobSet maxRestarts + Orbax auto-resume; this test is the chaos rung
+of the ladder: a real `python -m eksml_tpu.train` process is killed
+-9 mid-run (no atexit, no flush — exactly a TPU preemption) and a
+relaunch with the same logdir must pick up from the last checkpoint
+and finish the run.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from conftest import TINY_MODEL_OVERRIDES
+
+TINY = TINY_MODEL_OVERRIDES + [
+    "TRAIN.STEPS_PER_EPOCH=2", "TRAIN.MAX_EPOCHS=3",  # 6 total steps
+    "TRAIN.CHECKPOINT_PERIOD=1",                      # ckpt every 2 steps
+    "TRAIN.LOG_PERIOD=1", "TRAIN.SYNC_CHECK_PERIOD=0",
+]
+
+
+def _launch(logdir, cache_dir, log_path):
+    env = dict(os.environ)
+    env.update({"EKSML_PLATFORM": "cpu",
+                "JAX_COMPILATION_CACHE_DIR": cache_dir})
+    # child output goes to a FILE: an undrained PIPE fills (~64KB) with
+    # XLA chatter and deadlocks the child mid-compile
+    logf = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "eksml_tpu.train", "--logdir", logdir,
+         "--synthetic", "--config"] + TINY,
+        env=env, stdout=logf, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _steps_logged(logdir):
+    path = os.path.join(logdir, "metrics.jsonl")
+    steps = []
+    if os.path.exists(path):
+        for line in open(path):
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn write from the killed process
+            if "total_loss" in d:
+                steps.append(d["step"])
+    return steps
+
+
+@pytest.mark.slow
+def test_sigkill_then_resume(tmp_path):
+    logdir = str(tmp_path / "run")
+    cache = str(tmp_path / "cache")  # 2nd launch skips the recompile
+
+    log1 = str(tmp_path / "run1.log")
+    proc = _launch(logdir, cache, log1)
+    try:
+        deadline = time.time() + 900
+        while time.time() < deadline:
+            if _steps_logged(logdir):
+                break
+            if proc.poll() is not None:
+                pytest.fail("trainer exited before first step:\n"
+                            + open(log1).read()[-2000:])
+            time.sleep(0.5)
+        else:
+            pytest.fail("no training step within budget")
+        # preemption: no SIGTERM courtesy, no flush
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    killed_at = max(_steps_logged(logdir))
+    if killed_at >= 6:
+        pytest.skip("run outran the kill on this machine — inconclusive")
+
+    log2 = str(tmp_path / "run2.log")
+    proc2 = _launch(logdir, cache, log2)
+    assert proc2.wait(timeout=900) == 0, open(log2).read()[-2000:]
+
+    steps = _steps_logged(logdir)
+    assert max(steps) == 6, steps
+    # auto-resume restarted from a checkpoint, not from scratch: the
+    # second process must never relog step 1 unless the kill landed
+    # before the first checkpoint (step 2)
+    if killed_at >= 2:
+        second_run_steps = steps[steps.index(killed_at) + 1:]
+        assert min(second_run_steps) >= 3, (killed_at, steps)

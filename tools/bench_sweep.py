@@ -25,12 +25,30 @@ import sys
 import time
 
 CONFIGS = [
-    # (name, extra argv) — first entry is the headline operating point
-    ("pallas_bf16", ["--roi-backend", "auto"]),
-    ("xla_bf16", ["--roi-backend", "xla"]),
-    ("pallas_bf16_remat", ["--roi-backend", "auto", "--remat"]),
-    ("pallas_f32", ["--roi-backend", "auto", "--precision", "float32"]),
+    # (name, extra argv, config KEY=VALUEs) — first entry is the
+    # headline operating point
+    ("pallas_bf16", ["--roi-backend", "auto"], []),
+    ("xla_bf16", ["--roi-backend", "xla"], []),
+    ("pallas_bf16_remat", ["--roi-backend", "auto", "--remat"], []),
+    ("pallas_f32", ["--roi-backend", "auto",
+                    "--precision", "float32"], []),
+    # the optimized chart's landscape bucket (PREPROC.BUCKETS): the
+    # canvas ~all landscape COCO images train at — quantifies the
+    # bucketed-padding win over the 1344 square above
+    ("pallas_bf16_bucket", ["--roi-backend", "auto",
+                            "--pad-hw", "832", "1344"], []),
+    # legacy f32 host-normalized ingest (PREPROC.DEVICE_NORMALIZE off)
+    ("pallas_bf16_f32ingest", ["--roi-backend", "auto"],
+     ["PREPROC.DEVICE_NORMALIZE=False"]),
 ]
+
+QUICK_SHAPES = ["--image-size", "128", "--batch-size", "1",
+                "--warmup", "1"]
+QUICK_CONFIG = ["DATA.NUM_CLASSES=5", "DATA.MAX_GT_BOXES=8",
+                "RPN.TRAIN_PRE_NMS_TOPK=64", "RPN.TRAIN_POST_NMS_TOPK=32",
+                "FRCNN.BATCH_PER_IM=16", "FPN.NUM_CHANNEL=32",
+                "FPN.FRCNN_FC_HEAD_DIM=64", "MRCNN.HEAD_DIM=16",
+                "BACKBONE.RESNET_NUM_BLOCKS=(1,1,1,1)"]
 
 
 def main(argv=None):
@@ -45,19 +63,26 @@ def main(argv=None):
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = []
-    for name, extra in CONFIGS:
+    for name, extra, config in CONFIGS:
         cmd = [sys.executable, os.path.join(repo, "bench.py"),
                "--steps", str(args.steps)] + extra
         if args.platform:
             cmd += ["--platform", args.platform]
         if args.quick:
-            cmd += ["--image-size", "128", "--batch-size", "1",
-                    "--warmup", "1", "--config", "DATA.NUM_CLASSES=5",
-                    "DATA.MAX_GT_BOXES=8", "RPN.TRAIN_PRE_NMS_TOPK=64",
-                    "RPN.TRAIN_POST_NMS_TOPK=32", "FRCNN.BATCH_PER_IM=16",
-                    "FPN.NUM_CHANNEL=32", "FPN.FRCNN_FC_HEAD_DIM=64",
-                    "MRCNN.HEAD_DIM=16",
-                    "BACKBONE.RESNET_NUM_BLOCKS=(1,1,1,1)"]
+            if "--pad-hw" in extra:
+                # scale the rectangular canvas down with the quick
+                # shapes so the bucket path still runs distinctly
+                i = extra.index("--pad-hw")
+                trimmed = extra[:i] + extra[i + 3:]
+                cmd = ([sys.executable, os.path.join(repo, "bench.py"),
+                        "--steps", str(args.steps)] + trimmed
+                       + ["--pad-hw", "128", "192"])  # dims % 64 == 0
+                if args.platform:
+                    cmd += ["--platform", args.platform]
+            cmd += QUICK_SHAPES
+            config = config + QUICK_CONFIG
+        if config:
+            cmd += ["--config"] + config
         t0 = time.time()
         entry = {"config": name}
         try:
