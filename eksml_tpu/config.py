@@ -333,6 +333,21 @@ def finalize_configs(is_training: bool) -> AttrDict:
     return _C
 
 
+# CPU-feasible shrunk-model KEY=VALUE overrides (compiles in ~1-4 min
+# on one core; full model takes 2h+).  Single source for the test
+# suite's subprocess drives and bench_sweep --quick so the two can't
+# drift onto different shapes.  Run-shape knobs (steps/epochs/periods/
+# image size) intentionally stay with each consumer.
+SMOKE_OVERRIDES = (
+    "DATA.NUM_CLASSES=5", "PREPROC.MAX_SIZE=128",
+    "PREPROC.TRAIN_SHORT_EDGE_SIZE=(128,128)", "DATA.MAX_GT_BOXES=8",
+    "RPN.TRAIN_PRE_NMS_TOPK=64", "RPN.TRAIN_POST_NMS_TOPK=32",
+    "FRCNN.BATCH_PER_IM=16", "FPN.NUM_CHANNEL=32",
+    "FPN.FRCNN_FC_HEAD_DIM=64", "MRCNN.HEAD_DIM=16",
+    "BACKBONE.RESNET_NUM_BLOCKS=(1,1,1,1)", "TEST.RESULTS_PER_IM=8",
+)
+
+
 def config_from_env(cfg: AttrDict = None) -> AttrDict:
     """Fill comm-layer settings from JobSet downward-API env vars.
 

@@ -25,17 +25,11 @@ import numpy as np
 import pytest
 
 
-# shared tiny-model KEY=VALUE overrides for subprocess-driven tests
-# (compiles in ~1-4 min on CPU); single source so test profiles don't
-# drift — run-shape knobs (steps/epochs/periods) stay with each test
-TINY_MODEL_OVERRIDES = [
-    "DATA.NUM_CLASSES=5", "PREPROC.MAX_SIZE=128",
-    "PREPROC.TRAIN_SHORT_EDGE_SIZE=(128,128)", "DATA.MAX_GT_BOXES=8",
-    "RPN.TRAIN_PRE_NMS_TOPK=64", "RPN.TRAIN_POST_NMS_TOPK=32",
-    "FRCNN.BATCH_PER_IM=16", "FPN.NUM_CHANNEL=32",
-    "FPN.FRCNN_FC_HEAD_DIM=64", "MRCNN.HEAD_DIM=16",
-    "BACKBONE.RESNET_NUM_BLOCKS=(1,1,1,1)", "TEST.RESULTS_PER_IM=8",
-]
+# shared tiny-model KEY=VALUE overrides for subprocess-driven tests —
+# canonical list lives in eksml_tpu.config.SMOKE_OVERRIDES
+from eksml_tpu.config import SMOKE_OVERRIDES
+
+TINY_MODEL_OVERRIDES = list(SMOKE_OVERRIDES)
 
 
 @pytest.fixture(autouse=True)

@@ -33,12 +33,22 @@ def _launch(logdir, cache_dir, log_path):
                 "JAX_COMPILATION_CACHE_DIR": cache_dir})
     # child output goes to a FILE: an undrained PIPE fills (~64KB) with
     # XLA chatter and deadlocks the child mid-compile
-    logf = open(log_path, "w")
-    return subprocess.Popen(
-        [sys.executable, "-m", "eksml_tpu.train", "--logdir", logdir,
-         "--synthetic", "--config"] + TINY,
-        env=env, stdout=logf, stderr=subprocess.STDOUT,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    with open(log_path, "w") as logf:  # child inherits the fd
+        return subprocess.Popen(
+            [sys.executable, "-m", "eksml_tpu.train", "--logdir", logdir,
+             "--synthetic", "--config"] + TINY,
+            env=env, stdout=logf, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+
+
+def _committed_ckpt_steps(logdir):
+    """Orbax-committed checkpoint steps (tmp dirs from an in-flight
+    async save are excluded by the digits-only filter)."""
+    d = os.path.join(logdir, "checkpoints")
+    if not os.path.isdir(d):
+        return []
+    return sorted(int(p) for p in os.listdir(d) if p.isdigit())
 
 
 def _steps_logged(logdir):
@@ -80,19 +90,27 @@ def test_sigkill_then_resume(tmp_path):
         if proc.poll() is None:
             proc.kill()
 
-    killed_at = max(_steps_logged(logdir))
-    if killed_at >= 6:
+    first_steps = _steps_logged(logdir)
+    if max(first_steps) >= 6:
         pytest.skip("run outran the kill on this machine — inconclusive")
+    # what the relaunch may restore: checkpoints COMMITTED before the
+    # kill (metrics for a step flush before its async save commits, so
+    # killed_at alone proves nothing about checkpoint existence)
+    committed = _committed_ckpt_steps(logdir)
 
     log2 = str(tmp_path / "run2.log")
     proc2 = _launch(logdir, cache, log2)
-    assert proc2.wait(timeout=900) == 0, open(log2).read()[-2000:]
+    try:
+        assert proc2.wait(timeout=900) == 0, open(log2).read()[-2000:]
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
 
     steps = _steps_logged(logdir)
     assert max(steps) == 6, steps
-    # auto-resume restarted from a checkpoint, not from scratch: the
-    # second process must never relog step 1 unless the kill landed
-    # before the first checkpoint (step 2)
-    if killed_at >= 2:
-        second_run_steps = steps[steps.index(killed_at) + 1:]
-        assert min(second_run_steps) >= 3, (killed_at, steps)
+    # auto-resume semantics: the second process starts exactly after
+    # the last COMMITTED checkpoint (from scratch if none committed)
+    expected_start = (max(committed) + 1) if committed else 1
+    second_run_steps = steps[len(first_steps):]
+    assert second_run_steps == list(range(expected_start, 7)), (
+        committed, first_steps, second_run_steps)

@@ -44,11 +44,13 @@ CONFIGS = [
 
 QUICK_SHAPES = ["--image-size", "128", "--batch-size", "1",
                 "--warmup", "1"]
-QUICK_CONFIG = ["DATA.NUM_CLASSES=5", "DATA.MAX_GT_BOXES=8",
-                "RPN.TRAIN_PRE_NMS_TOPK=64", "RPN.TRAIN_POST_NMS_TOPK=32",
-                "FRCNN.BATCH_PER_IM=16", "FPN.NUM_CHANNEL=32",
-                "FPN.FRCNN_FC_HEAD_DIM=64", "MRCNN.HEAD_DIM=16",
-                "BACKBONE.RESNET_NUM_BLOCKS=(1,1,1,1)"]
+# canonical shrunk-model profile (single source: eksml_tpu.config);
+# bench.py's explicit --image-size/--pad-hw wins over its PREPROC keys
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from eksml_tpu.config import SMOKE_OVERRIDES  # noqa: E402
+
+QUICK_CONFIG = list(SMOKE_OVERRIDES)
 
 
 def main(argv=None):
@@ -64,21 +66,16 @@ def main(argv=None):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = []
     for name, extra, config in CONFIGS:
+        if args.quick and "--pad-hw" in extra:
+            # scale the rectangular canvas down with the quick shapes
+            # so the bucket path still runs distinctly (dims % 64 == 0)
+            i = extra.index("--pad-hw")
+            extra = extra[:i + 1] + ["128", "192"] + extra[i + 3:]
         cmd = [sys.executable, os.path.join(repo, "bench.py"),
                "--steps", str(args.steps)] + extra
         if args.platform:
             cmd += ["--platform", args.platform]
         if args.quick:
-            if "--pad-hw" in extra:
-                # scale the rectangular canvas down with the quick
-                # shapes so the bucket path still runs distinctly
-                i = extra.index("--pad-hw")
-                trimmed = extra[:i] + extra[i + 3:]
-                cmd = ([sys.executable, os.path.join(repo, "bench.py"),
-                        "--steps", str(args.steps)] + trimmed
-                       + ["--pad-hw", "128", "192"])  # dims % 64 == 0
-                if args.platform:
-                    cmd += ["--platform", args.platform]
             cmd += QUICK_SHAPES
             config = config + QUICK_CONFIG
         if config:

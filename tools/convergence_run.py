@@ -57,6 +57,10 @@ def main(argv=None):
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
+    from eksml_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # a rerun must never re-pay the compile
+
     import numpy as np
 
     from tools.make_shapes_coco import make_split
@@ -130,6 +134,7 @@ def main(argv=None):
         "steps": args.steps,
         "image_size": size,
         "batch_size": args.batch_size,
+        "overrides": list(args.config),
         "train_seconds": round(train_time, 1),
         "early_loss": round(early, 4),
         "late_loss": round(late, 4),
