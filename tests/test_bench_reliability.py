@@ -1,0 +1,107 @@
+"""The perf-evidence machinery itself (round-1 lesson: one fragile
+codepath lost the round's only perf artifact).
+
+Covers bench.py's bounded-retry device init and the collective-flag
+probe's rollback — the two places where a flaky tunnel or an old
+libtpu must degrade to a warning, never a dead run.
+"""
+
+import os
+import time
+
+import pytest
+
+import bench as bench_mod
+from eksml_tpu.parallel import collectives
+
+
+def test_init_devices_retries_then_succeeds(monkeypatch):
+    calls = {"n": 0}
+
+    class FakeJax:
+        @staticmethod
+        def devices():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("UNAVAILABLE: tunnel flake")
+            return ["chip0"]
+
+    monkeypatch.setitem(__import__("sys").modules, "jax", FakeJax)
+    out = bench_mod._init_devices(retries=5, backoff=0.01,
+                                  attempt_timeout=5.0)
+    assert out == ["chip0"] and calls["n"] == 3
+
+
+def test_init_devices_raises_after_exhaustion(monkeypatch):
+    class FakeJax:
+        @staticmethod
+        def devices():
+            raise RuntimeError("UNAVAILABLE: still down")
+
+    monkeypatch.setitem(__import__("sys").modules, "jax", FakeJax)
+    with pytest.raises(RuntimeError, match="still down"):
+        bench_mod._init_devices(retries=2, backoff=0.01,
+                                attempt_timeout=5.0)
+
+
+def test_init_devices_times_out_hung_backend(monkeypatch):
+    """A hung jax.devices() (wedged tunnel) must convert into a
+    TimeoutError instead of blocking the bench forever."""
+    release = {"stop": False}
+
+    class FakeJax:
+        @staticmethod
+        def devices():
+            while not release["stop"]:  # hang until the test ends
+                time.sleep(0.05)
+
+    monkeypatch.setitem(__import__("sys").modules, "jax", FakeJax)
+    try:
+        with pytest.raises(TimeoutError, match="tunnel hang"):
+            bench_mod._init_devices(retries=1, backoff=0.01,
+                                    attempt_timeout=0.3)
+    finally:
+        release["stop"] = True  # unstick the worker thread
+
+
+def test_main_emits_diagnostic_json_on_failure(monkeypatch, capsys):
+    """Any failure inside run() must still land one parseable JSON
+    line (the driver records stdout; a stack trace is not evidence)."""
+    import json
+
+    monkeypatch.setattr(bench_mod, "run",
+                        lambda args, diag: (_ for _ in ()).throw(
+                            RuntimeError("boom")))
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "1"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    diag = json.loads(line)
+    assert diag["value"] == 0.0
+    assert "boom" in diag["error"]
+
+
+def test_collective_flag_rollback_on_rejection(monkeypatch):
+    """A combine-threshold flag an old libtpu rejects must be rolled
+    back out of LIBTPU_INIT_ARGS (one bad flag otherwise fails EVERY
+    subsequent compile — observed live on the v5e tunnel)."""
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", "--xla_keep_me=1")
+    monkeypatch.setattr(collectives.jax, "default_backend",
+                        lambda: "tpu")
+
+    def bad_jit(fn):
+        raise RuntimeError("Unknown flag: combine_threshold")
+
+    monkeypatch.setattr(collectives.jax, "jit", bad_jit)
+    collectives.set_xla_collective_flags(64 * 1024 * 1024)
+    flags = os.environ["LIBTPU_INIT_ARGS"]
+    assert "all_reduce_combine_threshold" not in flags
+    assert "--xla_keep_me=1" in flags
+
+
+def test_collective_flag_kept_when_probe_passes(monkeypatch):
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", "")
+    monkeypatch.setattr(collectives.jax, "default_backend",
+                        lambda: "cpu")  # no TPU -> no probe, flag kept
+    collectives.set_xla_collective_flags(1234)
+    assert "all_reduce_combine_threshold_bytes=1234" in \
+        os.environ["LIBTPU_INIT_ARGS"]

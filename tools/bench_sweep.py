@@ -44,8 +44,11 @@ CONFIGS = [
 
 QUICK_SHAPES = ["--image-size", "128", "--batch-size", "1",
                 "--warmup", "1"]
-# canonical shrunk-model profile (single source: eksml_tpu.config);
-# bench.py's explicit --image-size/--pad-hw wins over its PREPROC keys
+# canonical shrunk-model profile (single source: eksml_tpu.config).
+# Its PREPROC keys overwrite bench.py's CLI-derived cfg values
+# (update_args runs last), but the benched batch shape still follows
+# --image-size/--pad-hw: make_synthetic_batch re-derives PREPROC from
+# the requested shape internally.
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 from eksml_tpu.config import SMOKE_OVERRIDES  # noqa: E402
