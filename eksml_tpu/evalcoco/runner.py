@@ -223,8 +223,38 @@ def run_evaluation(model, params, cfg, records: List[Dict],
         return images, hw, scales, ids
 
     n_batches = len(plan)  # 0 possible: empty shard in bucket mode
-    host_dets = []  # per-image: original-coord boxes/scores/classes(+RLEs)
-    with ThreadPoolExecutor(max_workers=1) as pool:
+
+    def postprocess_row(iid, keep, row_boxes, row_scores, row_classes,
+                        row_masks, scale):
+        """Per-image host work: rescale to original coords, paste +
+        RLE-encode masks.  Runs on a worker pool so the accelerator's
+        next batch predicts while masks paste (numpy + GIL-releasing
+        native RLE), instead of idling behind this loop."""
+        boxes = (row_boxes[keep] / scale).astype(np.float32)
+        det = {
+            "image_id": iid,
+            "boxes": boxes,
+            "scores": row_scores[keep].astype(np.float32),
+            "classes": row_classes[keep].astype(np.int32),
+        }
+        if row_masks is not None:
+            rec = by_id[iid]
+            h, w = rec["height"], rec["width"]
+            det["rles"] = [rle_encode(paste_mask(m, bx, h, w))
+                           for m, bx in zip(row_masks[keep], boxes)]
+        return det
+
+    post_workers = max(1, int(getattr(cfg.DATA, "NUM_WORKERS", 0) or 1))
+    # bounded pipeline: a queued row pins its whole batch's output
+    # arrays (the row views share the batch base buffer), so cap the
+    # outstanding rows to a few batches' worth — keeps paste/RLE
+    # overlapped with the next predict without accumulating every raw
+    # batch on the host, and surfaces worker errors within ~2 batches
+    max_pending = max(post_workers, 2 * batch_size)
+    pending: List = []
+    host_dets = []
+    with ThreadPoolExecutor(max_workers=1) as pool, \
+            ThreadPoolExecutor(max_workers=post_workers) as post_pool:
         nxt = pool.submit(build_batch, 0) if n_batches else None
         for b in range(n_batches):
             images, hw, scales, ids = nxt.result()
@@ -236,22 +266,14 @@ def run_evaluation(model, params, cfg, records: List[Dict],
                 iid = int(ids[i])
                 if iid < 0:
                     continue  # padding row
-                keep = out["valid"][i] > 0
-                boxes = (out["boxes"][i][keep] / scales[i]).astype(
-                    np.float32)
-                det = {
-                    "image_id": iid,
-                    "boxes": boxes,
-                    "scores": out["scores"][i][keep].astype(np.float32),
-                    "classes": out["classes"][i][keep].astype(np.int32),
-                }
-                if with_masks and "masks" in out:
-                    rec = by_id[iid]
-                    h, w = rec["height"], rec["width"]
-                    det["rles"] = [
-                        rle_encode(paste_mask(m, bx, h, w))
-                        for m, bx in zip(out["masks"][i][keep], boxes)]
-                host_dets.append(det)
+                pending.append(post_pool.submit(
+                    postprocess_row, iid, out["valid"][i] > 0,
+                    out["boxes"][i], out["scores"][i], out["classes"][i],
+                    (out["masks"][i] if with_masks and "masks" in out
+                     else None), scales[i]))
+                while len(pending) > max_pending:  # FIFO keeps order
+                    host_dets.append(pending.pop(0).result())
+        host_dets.extend(f.result() for f in pending)
 
     if num_hosts > 1:
         all_dets = _gather_detection_lists(host_dets)
