@@ -177,6 +177,35 @@ def test_optimized_values_match_reference_deltas():
     assert "TRAIN.GRADIENT_CLIP=0.36" in vals["extra_config"]
 
 
+def test_optimized_extra_config_round_trips_through_config():
+    """The chart template splits extra_config on spaces
+    (templates/maskrcnn.yaml splitList) and passes each token to
+    --config; every token — including the space-free PREPROC.BUCKETS
+    tuple — must parse and finalize."""
+    from eksml_tpu.config import config as cfg
+    from eksml_tpu.config import finalize_configs
+
+    vals = yaml.safe_load(
+        _read("charts/maskrcnn-optimized/values.yaml"))["maskrcnn"]
+    tokens = vals["extra_config"].split(" ")
+    assert all("=" in t for t in tokens), tokens
+
+    saved = cfg.to_dict()
+    cfg.freeze(False)
+    try:
+        cfg.update_args(tokens)
+        finalize_configs(is_training=True)
+        assert cfg.PREPROC.BUCKETS, "chart should enable buckets"
+        for b in cfg.PREPROC.BUCKETS:
+            assert len(b) == 2
+        assert cfg.TRAIN.GRADIENT_CLIP == 0.36
+        assert cfg.TRAIN.REMAT is True
+    finally:
+        cfg.freeze(False)
+        cfg.from_dict(saved)
+        cfg.freeze()
+
+
 def test_jobset_chart_topologies_match_runtime_inventory():
     from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES
 
