@@ -17,8 +17,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# runnable as `python tools/bench_loader.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(argv=None):
@@ -66,8 +70,6 @@ def main(argv=None):
     print(f"loader: {n} images in {dt:.1f}s "
           f"({args.workers} workers, masks={not args.no_masks})",
           file=sys.stderr)
-    import os
-
     cores = os.cpu_count() or 1
     print(json.dumps({
         "metric": "loader_throughput",
