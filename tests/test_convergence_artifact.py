@@ -30,4 +30,5 @@ def test_artifact_shows_material_convergence():
     assert all(math.isfinite(c["total_loss"]) and c["total_loss"] > 0
                for c in art["curve"])
     # provenance recorded so the capacity/size context is auditable
-    assert art["overrides"] and art["device"]
+    # (overrides may legitimately be [] for a full-size default run)
+    assert "overrides" in art and art["device"]
