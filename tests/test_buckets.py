@@ -106,6 +106,35 @@ def test_force_fit_under_shard_skew(fresh_config):
     assert (512, 320) in shapes, "portrait bucket never force-fit"
 
 
+def test_multiscale_draws_always_fit_assigned_bucket(fresh_config):
+    """assign_bucket uses the MAX short-edge draw as an upper bound;
+    with a multiscale TRAIN_SHORT_EDGE_SIZE range every random draw
+    must still fit the assigned canvas without force-fit shrinking
+    (content dims == the standard resize at the drawn scale)."""
+    from eksml_tpu.data.loader import _resized_hw
+
+    cfg = _cfg(fresh_config)
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (256, 320)  # multiscale
+    recs = _mixed_records()
+    by_id = {r["image_id"]: (r["height"], r["width"]) for r in recs}
+    loader = DetectionLoader(recs, cfg, batch_size=2, seed=13,
+                             prefetch=1)
+    for batch in loader.batches(10):
+        canvas = batch["images"].shape[1:3]
+        for i in range(2):
+            h, w = by_id[int(batch["image_id"][i])]
+            nh, nw = batch["image_hw"][i]
+            # content fits the canvas...
+            assert nh <= canvas[0] and nw <= canvas[1]
+            # ...and matches SOME standard resize in the draw range
+            # (i.e. no force-fit shrink was needed)
+            fits = [
+                (s_nh, s_nw)
+                for s in range(256, 321)
+                for _, s_nh, s_nw in [_resized_hw(h, w, s, 512)]]
+            assert (int(nh), int(nw)) in fits, (h, w, nh, nw, canvas)
+
+
 def test_eval_loader_ignores_buckets(fresh_config):
     cfg = _cfg(fresh_config)
     loader = DetectionLoader(_mixed_records(), cfg, batch_size=2,
