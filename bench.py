@@ -49,6 +49,38 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload))
 
 
+LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "artifacts", "bench_last_good.json")
+
+
+def _bank_last_good(diag: dict) -> None:
+    """Persist every successful result so a later wedged-tunnel run
+    can still cite real hardware evidence (VERDICT r2 weak #2: a 0.0
+    round artifact erased numbers the repo had already measured)."""
+    try:
+        rec = dict(diag)
+        rec["banked_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())
+        os.makedirs(os.path.dirname(LAST_GOOD), exist_ok=True)
+        with open(LAST_GOOD, "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError as e:
+        print(f"bench: could not bank last-good: {e}", file=sys.stderr)
+
+
+def _attach_last_good(diag: dict) -> None:
+    """On failure, carry the most recent banked success inside the
+    diagnostic line, clearly marked stale — the live failure and the
+    last real measurement travel together."""
+    try:
+        with open(LAST_GOOD) as f:
+            rec = json.load(f)
+        rec["stale"] = True
+        diag["last_good"] = rec
+    except (OSError, ValueError):
+        pass
+
+
 def _init_devices(retries: int, backoff: float, attempt_timeout: float):
     """jax.devices() with bounded retry/backoff AND a per-attempt
     deadline — the tunnel can throw UNAVAILABLE transiently or hang
@@ -150,6 +182,7 @@ def main(argv=None):
 
         diag["error"] = f"{type(e).__name__}: {e}"
         diag["trace_tail"] = traceback.format_exc().splitlines()[-3:]
+        _attach_last_good(diag)
         _emit(diag)
     # a timed-out init attempt leaves a non-daemon worker thread stuck
     # inside jax.devices(); normal interpreter shutdown would join it
@@ -183,12 +216,6 @@ def run(args, diag: dict) -> None:
 
     shape = tuple(args.pad_hw) if args.pad_hw else args.image_size
     size = max(args.pad_hw) if args.pad_hw else args.image_size
-    for d in (args.pad_hw or [args.image_size]):
-        if d % 64:
-            raise ValueError(
-                f"pad dim {d} must be divisible by the coarsest FPN "
-                "stride (64): anchor grids are computed at H//stride "
-                "and must match the conv feature maps")
     cfg.freeze(False)
     cfg.TRAIN.PRECISION = args.precision
     cfg.TRAIN.REMAT = args.remat
@@ -197,6 +224,15 @@ def run(args, diag: dict) -> None:
     cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (size, size)
     cfg.update_args(args.config)
     cfg.freeze()
+    # Validate AFTER update_args so a sweep overriding the strides is
+    # checked against the strides it actually runs with.
+    coarsest = max(cfg.FPN.ANCHOR_STRIDES)
+    for d in (args.pad_hw or [args.image_size]):
+        if d % coarsest:
+            raise ValueError(
+                f"pad dim {d} must be divisible by the coarsest FPN "
+                f"stride ({coarsest}): anchor grids are computed at "
+                "H//stride and must match the conv feature maps")
 
     devices = _init_devices(args.init_retries, args.init_backoff,
                             args.init_timeout)
@@ -287,6 +323,8 @@ def run(args, diag: dict) -> None:
         mfu = flops_per_step / (dt / args.steps) / (peak * n_dev)
         diag["mfu"] = round(mfu, 4)
         diag["tflops_per_step"] = round(flops_per_step / 1e12, 2)
+    if diag["value"] > 0:
+        _bank_last_good(diag)
     _emit(diag)
 
 

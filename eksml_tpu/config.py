@@ -301,6 +301,28 @@ def finalize_configs(is_training: bool) -> AttrDict:
             int(d) % max(_C.FPN.ANCHOR_STRIDES) == 0 for d in b), (
             f"bucket {b!r}: must be an (H, W) pair with dims divisible "
             "by the coarsest FPN stride")
+    if buckets:
+        # A bucket set whose largest canvas cannot hold the worst-case
+        # standard resize (short edge at max(TRAIN_SHORT_EDGE_SIZE),
+        # long edge up to MAX_SIZE) silently force-fit shrinks those
+        # images below the configured training resolution
+        # (assign_bucket's fallback).  Warn loudly instead of letting
+        # resolution quietly degrade.
+        import logging
+        smax = max(_C.PREPROC.TRAIN_SHORT_EDGE_SIZE)
+        lmax = _C.PREPROC.MAX_SIZE
+        bh, bw = max(buckets, key=lambda b: b[0] * b[1])
+        for (need_h, need_w), orient in (((smax, lmax), "landscape"),
+                                         ((lmax, smax), "portrait")):
+            if not any(b[0] >= need_h and b[1] >= need_w
+                       for b in buckets):
+                logging.getLogger(__name__).warning(
+                    "PREPROC.BUCKETS: no bucket holds a worst-case %s "
+                    "resize (%dx%d at TRAIN_SHORT_EDGE_SIZE=%d / "
+                    "MAX_SIZE=%d); such images will force-fit into the "
+                    "largest bucket (%dx%d) BELOW the configured "
+                    "resolution", orient, need_h, need_w, smax, lmax,
+                    bh, bw)
     if isinstance(_C.DATA.TRAIN, str):
         _C.DATA.TRAIN = (_C.DATA.TRAIN,)
 

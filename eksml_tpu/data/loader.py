@@ -365,6 +365,13 @@ class DetectionLoader:
         order = self._bucket_orders[b]
         if len(order) == 0:
             return self.buckets[b], self._next_indices()
+        # When the host-local order is shorter than the batch the
+        # position wraps mid-batch (after a reshuffle), so a record can
+        # repeat within one batch — same sample-with-replacement
+        # behavior as _next_indices at epoch boundaries, just likelier
+        # for rare buckets.  Deliberate: per-batch uniqueness would
+        # skew rare-bucket sampling odds across hosts and the schedule
+        # must stay draw-count identical everywhere.
         out = []
         for _ in range(self.batch_size):
             if self._bucket_pos[b] == 0:

@@ -152,6 +152,10 @@ def run_evaluation(model, params, cfg, records: List[Dict],
         # arrays are fully addressable, so np.asarray is a local read;
         # the re-put lands on this host's devices only.
         params = jax.tree.map(np.asarray, params)
+        # Commit the localized copy onto a local device once; without
+        # this every predict_fn call re-uploads the full parameter set
+        # host→device (advisor r2).
+        params = jax.device_put(params, jax.local_devices()[0])
 
     # batch plan: [(canvas_hw, [rec|None, ...]), ...].  With
     # PREPROC.BUCKETS the shard is grouped by canvas so each batch pads

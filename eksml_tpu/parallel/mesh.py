@@ -37,6 +37,35 @@ V5E_TOPOLOGIES = {
     "v5e-256": (256, 64),
 }
 
+# Physical chip grid per slice (mirrors the C++ inventory,
+# native_src/topology.cc kSlices).  ``{x}x{y}`` is exactly the
+# ``cloud.google.com/gke-tpu-topology`` node label GKE puts on v5e
+# podslice nodes — the one the workload chart's nodeSelector must
+# match, or pods sit Pending forever.  Single source of truth for the
+# chart helper map, the terraform default and the values schema
+# (asserted against all three in tests/test_orchestration.py).
+V5E_TOPOLOGY_GRIDS = {
+    "v5e-1": (1, 1),
+    "v5e-4": (2, 2),
+    "v5e-8": (2, 4),
+    "v5e-16": (4, 4),
+    "v5e-32": (4, 8),
+    "v5e-64": (8, 8),
+    "v5e-128": (8, 16),
+    "v5e-256": (16, 16),
+}
+
+
+def topology_label(topology: str) -> str:
+    """GKE ``gke-tpu-topology`` node-label string for a slice name
+    (``v5e-32`` → ``"4x8"``)."""
+    if topology not in V5E_TOPOLOGY_GRIDS:
+        raise ValueError(
+            f"unknown TPU topology {topology!r}; valid: "
+            f"{sorted(V5E_TOPOLOGY_GRIDS)}")
+    x, y = V5E_TOPOLOGY_GRIDS[topology]
+    return f"{x}x{y}"
+
 
 def validate_topology(topology: str = "", num_chips: Optional[int] = None,
                       chips_per_host: int = 4) -> Tuple[int, int]:

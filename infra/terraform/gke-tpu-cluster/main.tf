@@ -114,7 +114,7 @@ resource "google_container_node_pool" "system" {
 
 # ---- TPU v5e slice nodepool (≙ GPU launch config + ASG :389-455) ----
 # One nodepool node = one v5e host (4 chips).  The slice topology
-# determines node count: v5e-32 = 8 hosts in one 8x4 podslice.
+# determines node count: v5e-32 = 8 hosts in one 4x8 podslice.
 
 resource "google_container_node_pool" "tpu" {
   name    = "tpu-${replace(var.tpu_topology, "x", "-")}"

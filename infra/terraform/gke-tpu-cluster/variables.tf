@@ -45,10 +45,12 @@ variable "tpu_machine_type" {
   default = "ct5lp-hightpu-4t"
 }
 
-# slice topology; v5e-32 north star = 8x4
+# slice topology label (physical chip grid, per the slice inventory
+# in eksml_tpu/parallel/mesh.py V5E_TOPOLOGY_GRIDS); v5e-32 north
+# star = 4x8
 variable "tpu_topology" {
   type    = string
-  default = "8x4"
+  default = "4x8"
 }
 
 # hosts in the slice = chips / 4 (≙ node_group_desired, :86-90)

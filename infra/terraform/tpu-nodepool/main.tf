@@ -64,9 +64,11 @@ variable "tpu_machine_type" {
   type    = string
   default = "ct5lp-hightpu-4t"
 }
+# physical chip grid label (v5e-32 = 4x8, per the slice inventory in
+# eksml_tpu/parallel/mesh.py V5E_TOPOLOGY_GRIDS)
 variable "tpu_topology" {
   type    = string
-  default = "8x4"
+  default = "4x8"
 }
 variable "tpu_hosts" {
   type    = number

@@ -19,11 +19,17 @@ def test_native_lib_builds():
 
 @pytest.mark.parametrize("name", sorted(V5E_TOPOLOGIES))
 def test_lookup_agrees_with_python_inventory(name):
+    from eksml_tpu.parallel.mesh import V5E_TOPOLOGY_GRIDS, topology_label
+
     info = topo_lookup(name)
     assert info is not None
     chips, hosts, mx, my = info
     assert (chips, hosts) == V5E_TOPOLOGIES[name]
     assert mx * my == chips  # physical grid covers the slice
+    # grid (and thus the gke-tpu-topology label) agrees across the
+    # C++ and python inventories
+    assert (mx, my) == V5E_TOPOLOGY_GRIDS[name]
+    assert topology_label(name) == f"{mx}x{my}"
 
 
 def test_lookup_unknown():

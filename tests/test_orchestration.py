@@ -213,6 +213,103 @@ def test_jobset_chart_topologies_match_runtime_inventory():
     assert set(vals["topologies"]) == set(V5E_TOPOLOGIES)
 
 
+# ---- gke-tpu-topology node label pipeline ---------------------------
+# GKE labels v5e podslice nodes with the physical chip grid
+# (v5e-32 → "4x8"); a nodeSelector carrying anything else (round 2
+# rendered "32x1") leaves every training pod Pending.  One source of
+# truth — the slice inventory's grid — must feed the chart helper map,
+# the terraform defaults and the schema.
+
+def _helper_topology_map(chart):
+    """Parse the `dict k v k v …` literal out of the topologyLabel
+    helper (no helm binary in the test env — string-extract)."""
+    tpl = _read(f"{chart}/templates/_helpers.tpl")
+    m = re.search(r'define "maskrcnn.topologyLabel".*?dict ([^\n]*?) -}}',
+                  tpl, re.S)
+    assert m, f"{chart}: topologyLabel helper with a dict literal missing"
+    toks = re.findall(r'"([^"]+)"', m.group(1))
+    assert len(toks) % 2 == 0
+    return dict(zip(toks[::2], toks[1::2]))
+
+
+@pytest.mark.parametrize("chart", ["charts/maskrcnn",
+                                   "charts/maskrcnn-optimized"])
+def test_rendered_topology_nodeselector_is_valid_gke_label(chart):
+    from eksml_tpu.parallel.mesh import (V5E_TOPOLOGY_GRIDS,
+                                         topology_label)
+
+    # the nodeSelector must come from the helper, not ad-hoc string
+    # surgery on the slice name
+    tmpl = _read(f"{chart}/templates/maskrcnn.yaml")
+    sel = re.search(r"cloud\.google\.com/gke-tpu-topology: (.*)", tmpl)
+    assert sel, "gke-tpu-topology nodeSelector missing"
+    assert 'include "maskrcnn.topologyLabel"' in sel.group(1), \
+        f"nodeSelector renders {sel.group(1)!r}, not the helper map"
+
+    # the helper map covers every inventory slice with its grid label
+    labels = _helper_topology_map(chart)
+    assert labels == {name: topology_label(name)
+                      for name in V5E_TOPOLOGY_GRIDS}
+    # grid labels are grids, not chip counts ("32x1"-style)
+    for name, label in labels.items():
+        x, y = map(int, label.split("x"))
+        chips = V5E_TOPOLOGY_GRIDS[name][0] * V5E_TOPOLOGY_GRIDS[name][1]
+        assert x * y == chips and x <= y, (name, label)
+
+
+@pytest.mark.parametrize("chart", ["charts/maskrcnn",
+                                   "charts/maskrcnn-optimized"])
+def test_tensorboard_logdir_contract(chart):
+    """The training JobSet's --logdir must land under the tensorboard
+    Deployment's --logdir for the same release — the coupling the
+    reference got from Helm release timestamping (reference
+    charts/maskrcnn/charts/tensorboard/templates/tensorboard.yaml:46-49).
+    Both templates substitute values; resolve them the way helm would
+    and compare the resulting paths."""
+    vals = yaml.safe_load(_read(f"{chart}/values.yaml"))
+    shared_fs = vals["global"]["shared_fs"]
+    data_fs = vals["maskrcnn"]["data_fs"]
+
+    train = _read(f"{chart}/templates/maskrcnn.yaml")
+    m = re.search(r"- --logdir\n\s+- (\S+)", train)
+    assert m, "training --logdir missing"
+    train_logdir = (m.group(1)
+                    .replace("{{ .Values.maskrcnn.data_fs }}", data_fs)
+                    .replace("{{ $runid }}", "rel-2026-01-01-00-00-00"))
+
+    tb = _read(f"{chart}/charts/tensorboard/templates/tensorboard.yaml")
+    m = re.search(r"--logdir=(\S+)", tb)
+    assert m, "tensorboard --logdir missing"
+    tb_logdir = m.group(1).replace(
+        "{{ .Values.global.shared_fs }}", shared_fs)
+
+    assert train_logdir.startswith(tb_logdir), (
+        f"training writes {train_logdir} but tensorboard watches "
+        f"{tb_logdir} — events would never appear")
+    # both sides must mount the same RWX claim, or the paths only
+    # coincide textually
+    assert "claimName: {{ .Values.global.shared_pvc }}" in train
+    assert "claimName: {{ .Values.global.shared_pvc }}" in tb
+
+
+def test_terraform_topology_defaults_are_valid_gke_labels():
+    from eksml_tpu.parallel.mesh import V5E_TOPOLOGY_GRIDS
+
+    valid = {f"{x}x{y}" for x, y in V5E_TOPOLOGY_GRIDS.values()}
+    for tf in ["infra/terraform/gke-tpu-cluster/variables.tf",
+               "infra/terraform/tpu-nodepool/main.tf"]:
+        text = _read(tf)
+        m = re.search(r'variable "tpu_topology" \{[^}]*?'
+                      r'default = "([^"]+)"', text, re.S)
+        assert m, f"{tf}: tpu_topology variable missing"
+        assert m.group(1) in valid, \
+            f"{tf}: default {m.group(1)!r} is not a valid " \
+            f"gke-tpu-topology label ({sorted(valid)})"
+    # the runbook's provisioning command must pass a valid label too
+    for val in re.findall(r"tpu_topology=(\S+)", _read("README.md")):
+        assert val in valid, f"README.md: tpu_topology={val} invalid"
+
+
 # ---- entrypoint scripts ---------------------------------------------
 
 def test_run_sh_contract():

@@ -1,0 +1,75 @@
+"""Round-over-round perf ledger (VERDICT r2 next #9).
+
+``artifacts/ledger.jsonl`` carries one record per round so progress is
+trendable even when a round's live TPU run fails (a wedged tunnel then
+still leaves the trajectory on disk).  Append-only; schema pinned by
+tests/test_ledger.py.
+
+Usage:
+    python tools/ledger.py --round 3 --bench 12.3 --mfu 0.31 \
+        --loader-imgs-per-sec 45.0 --convergence-bbox-ap50 0.21 \
+        --suite-passed 170 --note "first nonzero TPU bench"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEDGER = os.path.join(REPO, "artifacts", "ledger.jsonl")
+
+# Every record carries exactly these keys (None = not measured that
+# round); the schema test fails on drift so old rows stay comparable.
+FIELDS = ("round", "bench_imgs_per_sec_chip", "mfu",
+          "loader_imgs_per_sec", "convergence_bbox_ap50",
+          "suite_passed", "note", "noted_at")
+
+
+def append(round_num: int, bench: float | None = None,
+           mfu: float | None = None,
+           loader_imgs_per_sec: float | None = None,
+           convergence_bbox_ap50: float | None = None,
+           suite_passed: int | None = None, note: str = "") -> dict:
+    rec = {
+        "round": int(round_num),
+        "bench_imgs_per_sec_chip": bench,
+        "mfu": mfu,
+        "loader_imgs_per_sec": loader_imgs_per_sec,
+        "convergence_bbox_ap50": convergence_bbox_ap50,
+        "suite_passed": suite_passed,
+        "note": note,
+        "noted_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    os.makedirs(os.path.dirname(LEDGER), exist_ok=True)
+    with open(LEDGER, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def read() -> list:
+    if not os.path.exists(LEDGER):
+        return []
+    with open(LEDGER) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--round", type=int, required=True)
+    p.add_argument("--bench", type=float, default=None)
+    p.add_argument("--mfu", type=float, default=None)
+    p.add_argument("--loader-imgs-per-sec", type=float, default=None)
+    p.add_argument("--convergence-bbox-ap50", type=float, default=None)
+    p.add_argument("--suite-passed", type=int, default=None)
+    p.add_argument("--note", default="")
+    a = p.parse_args(argv)
+    rec = append(a.round, a.bench, a.mfu, a.loader_imgs_per_sec,
+                 a.convergence_bbox_ap50, a.suite_passed, a.note)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
