@@ -158,6 +158,13 @@ def _define_defaults() -> None:
     # decode/augment worker threads per host (≙ TensorPack's
     # multiprocess dataflow prefetch); 0 = inline in the producer
     _C.DATA.NUM_WORKERS = 8
+    # JPEG-decode worker PROCESSES (0 = decode on the threads above).
+    # PIL decode holds the GIL, so on a many-core host feeding 4 chips
+    # of 1344px images the thread pool alone can't scale decode —
+    # TensorPack's dataflow was multiprocess for exactly this reason
+    # (reference container/Dockerfile:16-19).  Resize/augment stay on
+    # the thread pipeline either way (native GIL-released resize).
+    _C.DATA.WORKER_PROCESSES = 0
 
     # ---- preprocessing (static shapes are load-bearing on TPU) ------
     _C.PREPROC.TRAIN_SHORT_EDGE_SIZE = (800, 800)

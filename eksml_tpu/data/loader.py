@@ -180,6 +180,8 @@ class DetectionLoader:
         if num_workers is None:
             num_workers = getattr(cfg.DATA, "NUM_WORKERS", 0)
         self.num_workers = num_workers
+        self.worker_processes = int(
+            getattr(cfg.DATA, "WORKER_PROCESSES", 0))
         self._order = np.arange(len(self.records))
         self._pos = 0
         self._init_buckets(records, cfg, seed)
@@ -245,13 +247,15 @@ class DetectionLoader:
         return short, do_flip
 
     def _load_example(self, rec: Dict, short: int, do_flip: bool,
-                      pad_hw: Optional[Tuple[int, int]] = None
+                      pad_hw: Optional[Tuple[int, int]] = None,
+                      image: Optional[np.ndarray] = None
                       ) -> Dict[str, np.ndarray]:
-        if rec.get("_image") is not None:
-            image = rec["_image"]
-        else:
-            from eksml_tpu.data.coco import load_image
-            image = load_image(rec["path"])
+        if image is None:
+            if rec.get("_image") is not None:
+                image = rec["_image"]
+            else:
+                from eksml_tpu.data.coco import load_image
+                image = load_image(rec["path"])
         boxes = rec["boxes"].copy()
         classes = rec["classes"]
         # crowd boxes are kept: the model treats them as ignore regions
@@ -405,6 +409,20 @@ class DetectionLoader:
 
             pool = ThreadPoolExecutor(max_workers=self.num_workers,
                                       thread_name_prefix="decode")
+        # DATA.WORKER_PROCESSES: JPEG decode sidesteps the GIL in
+        # worker processes (spawn: no forked JAX/TPU client state);
+        # everything downstream of decode stays on the thread pipeline
+        proc_pool = None
+        if (self.worker_processes > 0
+                and any(r.get("_image") is None for r in self.records)):
+            from concurrent.futures import ProcessPoolExecutor
+            from multiprocessing import get_context
+
+            from eksml_tpu.data.coco import load_image
+
+            proc_pool = ProcessPoolExecutor(
+                max_workers=self.worker_processes,
+                mp_context=get_context("spawn"))
 
         def producer():
             produced = 0
@@ -414,14 +432,23 @@ class DetectionLoader:
                     pad_hw, idx = self._next_bucket_batch()
                     recs = [self.records[i] for i in idx]
                     draws = [self._draw() for _ in idx]
+                    images = [None] * len(recs)
+                    if proc_pool is not None:
+                        futs = {
+                            i: proc_pool.submit(load_image, r["path"])
+                            for i, r in enumerate(recs)
+                            if r.get("_image") is None}
+                        for i, fut in futs.items():
+                            images[i] = fut.result()
                     if pool is not None:
                         exs = list(pool.map(
                             self._load_example, recs,
                             [d[0] for d in draws], [d[1] for d in draws],
-                            [pad_hw] * len(recs)))
+                            [pad_hw] * len(recs), images))
                     else:
-                        exs = [self._load_example(r, s, f, pad_hw)
-                               for r, (s, f) in zip(recs, draws)]
+                        exs = [self._load_example(r, s, f, pad_hw, img)
+                               for r, (s, f), img
+                               in zip(recs, draws, images)]
                     batch = {k: np.stack([e[k] for e in exs])
                              for k in exs[0].keys()}
                     if not put_or_stop(batch):
@@ -447,6 +474,8 @@ class DetectionLoader:
             t.join(timeout=5.0)
             if pool is not None:
                 pool.shutdown(wait=False)
+            if proc_pool is not None:
+                proc_pool.shutdown(wait=False, cancel_futures=True)
 
 
 def _crop_resize_binary(mask: np.ndarray, box, out_size: int) -> np.ndarray:

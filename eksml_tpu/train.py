@@ -239,6 +239,28 @@ class Trainer:
 
     # -- loop ----------------------------------------------------------
 
+    def _globalize_batch(self, batch: Dict[str, np.ndarray]):
+        """Host-local loader batch → batch-sharded global arrays.
+
+        The loader yields each host ITS shard (per-host rows); in
+        multi-process the global batch only exists as the concatenation
+        of every host's rows, which ``host_local_array_to_global_array``
+        assembles without any cross-host transfer (each host's rows
+        already sit on its own devices).  A bare ``device_put`` onto the
+        data-axis sharding would instead treat the local rows as the
+        whole global batch and fail the divisibility check — the bug
+        the composed multi-host e2e (tests/test_multihost_e2e.py)
+        caught in round 3.
+        """
+        batch = {k: v for k, v in batch.items()
+                 if k not in ("image_scale", "image_id")}
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.host_local_array_to_global_array(
+                batch, self.mesh, jax.sharding.PartitionSpec("data"))
+        return jax.device_put(batch, self._batch_sharding)
+
     def fit(self, batches: Iterator[Dict[str, np.ndarray]],
             total_steps: int, start_step: int = 0,
             state: Optional[TrainState] = None,
@@ -259,16 +281,13 @@ class Trainer:
 
         step = start_step
         for batch in batches:
+            device_batch = self._globalize_batch(batch)
             if state is None:
-                state, step = self.restore_or_init(batch)
+                state, step = self.restore_or_init(device_batch)
                 if step >= total_steps:
                     break
             if step_fn is None:
                 step_fn = self.compiled_step()
-            device_batch = jax.device_put(
-                {k: v for k, v in batch.items()
-                 if k not in ("image_scale", "image_id")},
-                self._batch_sharding)
             state, metrics = step_fn(state, device_batch)
             step += 1
 

@@ -1,34 +1,66 @@
-"""Banked convergence evidence (VERDICT r1 item 7).
+"""Banked convergence evidence (VERDICT r1 item 7, r2 missing #3).
 
 `tools/convergence_run.py` trains the full detection pipeline on the
 learnable shapes dataset and banks the loss curve + final APs as
-`artifacts/convergence_r2.json`.  This test pins the banked artifact's
-convergence facts so a regression that silently broke learning (loss
-plumbing, target assignment, eval) can't hide behind a stale artifact:
-regenerating the artifact with a broken pipeline fails here.
+`artifacts/convergence_r{N}.json`.  These tests pin every banked
+artifact's convergence facts so a regression that silently broke
+learning (loss plumbing, target assignment, eval) can't hide behind a
+stale artifact — regenerating an artifact with a broken pipeline fails
+here — and trend the artifacts round-over-round (VERDICT r2 weak #3:
+the numbers were pinned but nothing required them to improve).
 """
 
+import glob
 import json
 import math
 import os
 
-ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "artifacts", "convergence_r2.json")
+import pytest
+
+_ART_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "artifacts")
 
 
-def test_artifact_shows_material_convergence():
-    with open(ARTIFACT) as f:
-        art = json.load(f)
-    # the two facts the reference's manual ladder watches in
-    # TensorBoard (charts/maskrcnn/values.yaml:16): loss down, AP up
-    assert art["loss_drop_pct"] > 30, art["loss_drop_pct"]
-    assert art["bbox_AP50"] > 0.05, art["bbox_AP50"]
-    assert art["segm_AP"] > 0.0, art["segm_AP"]
-    # curve integrity: monotone steps covering the run, finite losses
-    steps = [c["step"] for c in art["curve"]]
-    assert steps == sorted(steps) and steps[-1] == art["steps"]
-    assert all(math.isfinite(c["total_loss"]) and c["total_loss"] > 0
-               for c in art["curve"])
-    # provenance recorded so the capacity/size context is auditable
-    # (overrides may legitimately be [] for a full-size default run)
-    assert "overrides" in art and art["device"]
+def _artifacts():
+    out = {}
+    for path in sorted(glob.glob(os.path.join(_ART_DIR,
+                                              "convergence_r*.json"))):
+        n = int(os.path.basename(path)[len("convergence_r"):-len(".json")])
+        with open(path) as f:
+            out[n] = json.load(f)
+    return out
+
+
+def test_artifacts_show_material_convergence():
+    arts = _artifacts()
+    assert 2 in arts, "round-2 convergence artifact missing"
+    for n, art in arts.items():
+        # the two facts the reference's manual ladder watches in
+        # TensorBoard (charts/maskrcnn/values.yaml:16): loss down, AP up
+        assert art["loss_drop_pct"] > 30, (n, art["loss_drop_pct"])
+        assert art["bbox_AP50"] > 0.05, (n, art["bbox_AP50"])
+        assert art["segm_AP"] > 0.0, (n, art["segm_AP"])
+        # curve integrity: monotone steps covering the run, finite loss
+        steps = [c["step"] for c in art["curve"]]
+        assert steps == sorted(steps) and steps[-1] == art["steps"]
+        assert all(math.isfinite(c["total_loss"]) and c["total_loss"] > 0
+                   for c in art["curve"])
+        # provenance recorded so the capacity/size context is auditable
+        # (overrides may legitimately be [] for a full-size default run)
+        assert "overrides" in art and art["device"]
+
+
+def test_round3_artifact_is_full_architecture_and_beats_r2():
+    """r2's artifact ran a shrunken backbone ((1,1,1,1), 64-ch FPN);
+    r3's must be the REAL R50-FPN (no architecture-shrinking overrides)
+    and at least match r2's AP50 (VERDICT r2 next #4)."""
+    arts = _artifacts()
+    if 3 not in arts:
+        pytest.skip("round-3 convergence artifact not yet banked")
+    r3 = arts[3]
+    shrink_keys = ("BACKBONE.RESNET_NUM_BLOCKS", "FPN.NUM_CHANNEL",
+                   "MRCNN.HEAD_DIM", "FPN.FRCNN_FC_HEAD_DIM")
+    assert not any(o.startswith(k) for o in r3["overrides"]
+                   for k in shrink_keys), r3["overrides"]
+    assert r3["bbox_AP50"] >= arts[2]["bbox_AP50"], (
+        r3["bbox_AP50"], arts[2]["bbox_AP50"])

@@ -180,6 +180,34 @@ def test_loader_worker_pool_determinism(fresh_config):
             np.testing.assert_array_equal(ba[k], bb[k], err_msg=k)
 
 
+def test_process_pool_decode_parity(fresh_config, tmp_path):
+    """DATA.WORKER_PROCESSES moves JPEG decode into worker processes
+    (the GIL sidestep TensorPack's multiprocess dataflow existed for);
+    batches must stay byte-identical to in-process decode."""
+    from tools.make_shapes_coco import make_split
+
+    make_split(str(tmp_path), "val2017", 6, 96, 0, 1000)
+    cfg = fresh_config
+    cfg.PREPROC.MAX_SIZE = 128
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (96, 96)
+    cfg.DATA.MAX_GT_BOXES = 8
+    recs = CocoDataset(str(tmp_path), "val2017").records()
+
+    cfg.DATA.WORKER_PROCESSES = 0
+    a = DetectionLoader(recs, cfg, 2, seed=3, gt_mask_size=28)
+    assert a.worker_processes == 0
+    batches_a = list(a.batches(3))
+
+    cfg.DATA.WORKER_PROCESSES = 2
+    b = DetectionLoader(recs, cfg, 2, seed=3, gt_mask_size=28)
+    assert b.worker_processes == 2
+    batches_b = list(b.batches(3))
+
+    for ba, bb in zip(batches_a, batches_b):
+        for k in ba:
+            np.testing.assert_array_equal(ba[k], bb[k], err_msg=k)
+
+
 @pytest.mark.slow
 def test_loader_throughput_floor():
     """Input-pipeline margin check (VERDICT r1 item 3).

@@ -1,0 +1,218 @@
+"""Composed multi-host e2e (VERDICT r2 next #6): 2 host processes ×
+4 CPU devices run the FULL v5e-32 contract in miniature —
+
+  bucketed train (cross-host schedule agreement)
+    → SIGKILL both ranks mid-run (TPU preemption)
+      → relaunch, auto-resume from the last COMMITTED checkpoint
+        → finish → distributed eval with the padded byte-buffer
+          detection gather (real model.predict, per-host plans differ).
+
+The pieces each have their own tests (test_multiprocess.py rendezvous/
+gather, test_fault_tolerance.py kill-resume, test_evalcoco.py bucketed
+eval); this is the composition nothing else exercises — what a real
+v5e-32 JobSet does across restarts.  The reference can only run this
+on a live cluster (SURVEY.md §4).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+WORKER = r"""
+import os, sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from eksml_tpu.parallel import initialize_from_env
+
+initialize_from_env()
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+import numpy as np
+from eksml_tpu.config import (SMOKE_OVERRIDES, config as cfg,
+                              finalize_configs)
+
+cfg.freeze(False)
+cfg.update_args(list(SMOKE_OVERRIDES))
+cfg.TRAIN.LOGDIR = os.environ["E2E_LOGDIR"]
+# two rectangular canvases (dims % 64 == 0) so the bucket schedule is
+# non-trivial and per-host eval plans can differ
+cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (64, 64)
+cfg.PREPROC.TEST_SHORT_EDGE_SIZE = 64
+cfg.PREPROC.BUCKETS = ((64, 128), (128, 64))
+cfg.TRAIN.STEPS_PER_EPOCH = 2
+cfg.TRAIN.MAX_EPOCHS = 3            # 6 total steps
+cfg.TRAIN.CHECKPOINT_PERIOD = 1     # commit every 2 steps
+cfg.TRAIN.LOG_PERIOD = 1
+cfg.TRAIN.SYNC_CHECK_PERIOD = 0
+cfg.TEST.EVAL_BATCH_SIZE = 2
+cfg.TEST.RESULTS_PER_IM = 4
+finalize_configs(is_training=True)
+
+from eksml_tpu.data import DetectionLoader, SyntheticDataset
+from eksml_tpu.train import Trainer
+
+pid = jax.process_index()
+
+def _records(n_each, seed0, id0):
+    recs = []
+    for j, (h, w) in enumerate([(64, 128), (128, 64)]):
+        for r in SyntheticDataset(num_images=n_each, height=h, width=w,
+                                  max_boxes=4, num_classes=5,
+                                  seed=seed0 + j).records():
+            r = dict(r)
+            r["image_id"] = id0 + len(recs)
+            recs.append(r)
+    return recs
+
+trainer = Trainer(cfg, cfg.TRAIN.LOGDIR)
+local_chips = sum(d.process_index == pid
+                  for d in trainer.mesh.devices.flat)
+loader = DetectionLoader(_records(6, 100, 1), cfg,
+                         cfg.TRAIN.BATCH_SIZE_PER_CHIP * local_chips,
+                         is_training=True, num_hosts=2, host_id=pid,
+                         seed=7, with_masks=cfg.MODE_MASK)
+state = trainer.fit(loader.batches(None), 6)
+print(f"worker {pid} TRAIN DONE", flush=True)
+
+# ---- distributed eval on the freshly trained params ----------------
+# (phase 2 only: phase 1 is killed before it gets here)
+from eksml_tpu.evalcoco.runner import run_evaluation
+
+res = run_evaluation(trainer.model, state.params, cfg,
+                     _records(2, 300, 1000)[:5])
+if pid == 0:
+    for k in ("bbox/AP", "segm/AP"):
+        assert k in res and np.isfinite(res[k]), (k, res)
+else:
+    assert res == {}, res
+print(f"worker {pid} E2E OK", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_workers(worker_py, repo, port, logdir, cache, tmp_path, tag):
+    procs, logs = [], []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": str(pid),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": repo,
+            "E2E_LOGDIR": logdir,
+            "JAX_COMPILATION_CACHE_DIR": cache,
+        })
+        log_path = str(tmp_path / f"{tag}-w{pid}.log")
+        logs.append(log_path)
+        logf = open(log_path, "w")  # PIPE deadlocks on XLA chatter
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker_py)], env=env,
+            stdout=logf, stderr=subprocess.STDOUT))
+    return procs, logs
+
+
+def _steps_logged(logdir):
+    path = os.path.join(logdir, "metrics.jsonl")
+    steps = []
+    if os.path.exists(path):
+        for line in open(path):
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "total_loss" in d:
+                steps.append(d["step"])
+    return steps
+
+
+def _committed_ckpt_steps(logdir):
+    d = os.path.join(logdir, "checkpoints")
+    if not os.path.isdir(d):
+        return []
+    return sorted(int(p) for p in os.listdir(d) if p.isdigit())
+
+
+@pytest.mark.slow
+def test_multihost_bucketed_train_kill_resume_eval(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+    logdir = str(tmp_path / "run")
+    cache = str(tmp_path / "cache")
+
+    # ---- phase 1: train, SIGKILL both ranks mid-run -----------------
+    procs, logs = _launch_workers(worker_py, repo, _free_port(),
+                                  logdir, cache, tmp_path, "p1")
+    try:
+        deadline = time.time() + 1200
+        while time.time() < deadline:
+            if _steps_logged(logdir):
+                break
+            # any exit before the first step — including rc 0 — is a
+            # failure; report the dead worker's OWN log
+            dead = [(i, p) for i, p in enumerate(procs)
+                    if p.poll() is not None]
+            if dead:
+                i, p = dead[0]
+                pytest.fail(
+                    f"phase-1 worker {i} exited rc={p.returncode} "
+                    "before first step:\n" + open(logs[i]).read()[-3000:])
+            time.sleep(0.5)
+        else:
+            pytest.fail("no training step within budget")
+        for p in procs:  # no courtesy signal — preemption semantics
+            p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    first_steps = _steps_logged(logdir)
+    if first_steps and max(first_steps) >= 6:
+        pytest.skip("phase 1 outran the kill — inconclusive")
+    committed = _committed_ckpt_steps(logdir)
+
+    # ---- phase 2: relaunch same logdir → resume, finish, eval -------
+    procs, logs = _launch_workers(worker_py, repo, _free_port(),
+                                  logdir, cache, tmp_path, "p2")
+    outs = []
+    try:
+        for p in procs:
+            assert p.wait(timeout=1500) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        outs = [open(lg).read() for lg in logs]
+    for pid in range(2):
+        assert f"worker {pid} TRAIN DONE" in outs[pid], outs[pid][-3000:]
+        assert f"worker {pid} E2E OK" in outs[pid], outs[pid][-3000:]
+
+    # resume semantics: phase 2 starts right after the last COMMITTED
+    # checkpoint (from scratch when none committed) and runs to 6
+    steps = _steps_logged(logdir)
+    assert max(steps) == 6, steps
+    expected_start = (max(committed) + 1) if committed else 1
+    second = steps[len(first_steps):]
+    assert second == list(range(expected_start, 7)), (
+        committed, first_steps, second)

@@ -1,0 +1,52 @@
+"""tools/trace_summary.py: family aggregation over a synthetic
+TensorBoard-format trace (the shape jax.profiler writes)."""
+
+import gzip
+import json
+import os
+
+from tools.trace_summary import summarize
+
+
+def _write_trace(tmp_path):
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "python host threads"}},
+        # device lane: the breakdown input
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 600.0,
+         "name": "%convolution.42"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 700, "dur": 200.0,
+         "name": "roi_align_kernel"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 950, "dur": 100.0,
+         "name": "roi_align_grad_fusion"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 1100, "dur": 100.0,
+         "name": "fused_nms.3"},
+        # host lane noise: must be excluded
+        {"ph": "X", "pid": 2, "tid": 1, "ts": 0, "dur": 9999.0,
+         "name": "python_dispatch"},
+    ]
+    d = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(d)
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+def test_summarize_families(tmp_path):
+    s = summarize(_write_trace(tmp_path))
+    assert s["total_device_us"] == 1000.0  # host lane excluded
+    pct = s["family_pct"]
+    assert pct["conv"] == 60.0
+    assert pct["roi_align_fwd"] == 20.0
+    assert pct["roi_align_bwd"] == 10.0
+    assert pct["nms"] == 10.0
+    assert s["top_ops"][0]["name"] == "%convolution.42"
+
+
+def test_missing_trace_raises(tmp_path):
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        summarize(str(tmp_path))

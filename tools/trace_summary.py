@@ -1,0 +1,121 @@
+"""Summarize a jax.profiler trace into a per-op-family time breakdown.
+
+Answers VERDICT r2 next #5: where does the train step actually go —
+backbone/FPN convs, ROIAlign forward, ROIAlign backward, NMS, resnet
+head — so the Pallas-backward go/no-go is decided on data, not vibes.
+
+Reads the TensorBoard-format ``*.trace.json.gz`` the profiler writes
+under ``<dir>/plugins/profile/<run>/`` and aggregates device-lane event
+durations by family (regex over XLA fusion/custom-call names).
+
+Usage::
+
+    python tools/trace_summary.py profile --out artifacts/profile_summary_r3.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+# op-name regex → family, first match wins.  XLA fusion names carry the
+# dominant op (e.g. "fusion.123" with metadata, or "%convolution.45");
+# pallas kernels keep their kernel name.
+FAMILIES = (
+    ("roi_align_bwd", r"roi.?align.*(bwd|backward|grad|transpose)|"
+                      r"(bwd|backward|grad).*roi.?align"),
+    ("roi_align_fwd", r"roi.?align"),
+    ("nms", r"non.?max|nms"),
+    ("conv", r"conv"),
+    ("matmul", r"dot|gemm|matmul|einsum"),
+    ("allreduce", r"all.?reduce|psum|reduce.?scatter|all.?gather|"
+                  r"collective"),
+    ("copy", r"copy|transpose|reshape|bitcast"),
+    ("reduce", r"reduce|cumsum|sort|top.?k"),
+    ("scatter_gather", r"scatter|gather|dynamic.?slice|dynamic.?update"),
+)
+
+
+def _load_trace_events(trace_dir: str):
+    pats = [os.path.join(trace_dir, "**", "*.trace.json.gz"),
+            os.path.join(trace_dir, "**", "*.trace.json")]
+    paths = [p for pat in pats for p in glob.glob(pat, recursive=True)]
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.trace.json(.gz) under {trace_dir!r} — run with "
+            "--profile first")
+    path = max(paths, key=os.path.getmtime)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f).get("traceEvents", []), path
+
+
+def summarize(trace_dir: str, top_n: int = 15) -> dict:
+    events, path = _load_trace_events(trace_dir)
+    # device lanes: TPU/accelerator op events carry "dur" (µs) and live
+    # on pids whose process_name mentions the device; host python lanes
+    # are excluded so the breakdown is device time, not dispatch time
+    pid_names = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev["pid"]] = ev.get("args", {}).get("name", "")
+    device_pids = {pid for pid, name in pid_names.items()
+                   if re.search(r"tpu|device|/device|xla", name, re.I)
+                   and not re.search(r"host|python", name, re.I)}
+
+    fam_us: dict = {}
+    op_us: dict = {}
+    total = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        if device_pids and ev.get("pid") not in device_pids:
+            continue
+        name = ev.get("name", "")
+        dur = float(ev["dur"])
+        total += dur
+        op_us[name] = op_us.get(name, 0.0) + dur
+        for fam, pat in FAMILIES:
+            if re.search(pat, name, re.I):
+                fam_us[fam] = fam_us.get(fam, 0.0) + dur
+                break
+        else:
+            fam_us["other"] = fam_us.get("other", 0.0) + dur
+
+    fam_pct = {k: round(100 * v / total, 2)
+               for k, v in sorted(fam_us.items(), key=lambda kv: -kv[1])}
+    top_ops = [{"name": k, "us": round(v, 1),
+                "pct": round(100 * v / total, 2)}
+               for k, v in sorted(op_us.items(),
+                                  key=lambda kv: -kv[1])[:top_n]]
+    return {"trace": path, "total_device_us": round(total, 1),
+            "family_pct": fam_pct, "top_ops": top_ops}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace_dir")
+    p.add_argument("--out", default=None)
+    p.add_argument("--top", type=int, default=15)
+    args = p.parse_args(argv)
+    try:
+        summary = summarize(args.trace_dir, args.top)
+    except FileNotFoundError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    out = json.dumps(summary, indent=1)
+    print(out)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
