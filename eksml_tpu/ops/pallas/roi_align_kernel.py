@@ -30,10 +30,15 @@ Semantics notes:
   levels) compute identical values — no silent fwd/bwd divergence for
   extreme aspect ratios.
 
-The backward pass reuses the XLA formulation via ``jax.custom_vjp``
-(gather-grads become scatter-adds XLA already emits well); making the
-backward a kernel too is a further optimization, not a correctness
-need.
+The backward wrt features is the TRANSPOSE of the same separable
+linear map, so it is also two MXU matmuls per ROI — no scatter at all:
+``d_tile = RyPᵀ @ g @ CxP`` with the *pooled* weight matrices
+(``RyP[i,t] = mean_a Ry[i·s+a, t]``; pooling is linear so it folds into
+the weights), accumulated into per-level HBM buffers via sequential
+read-modify-write DMA (the grid is sequential per core — no write
+races; buffers start zeroed through ``input_output_aliases``).
+``EKSML_ROI_BWD={auto,pallas,xla}`` selects it (auto = probe on TPU,
+else the XLA gather-transpose formulation via ``jax.custom_vjp``).
 """
 
 from __future__ import annotations
@@ -114,6 +119,25 @@ def pallas_roi_align_supported(dtype=jnp.float32) -> bool:
     return _PROBE_RESULTS[key]
 
 
+def _bilinear_weights(start, binsz, out_size: int, sampling: int):
+    """[S, T] two-tap bilinear weight matrix for sample coords
+    ``start + (bin + (j+0.5)/sampling) * binsz`` — the ONE definition
+    of the sampling semantics; forward contracts it directly, backward
+    uses its bin-pooled mean.  Any change here keeps fwd/bwd transposed
+    by construction."""
+    s_total = out_size * sampling
+    f32 = jnp.float32
+    # Mosaic's iota is integer-only; build int32 and convert
+    s_idx = jax.lax.broadcasted_iota(
+        jnp.int32, (s_total, TILE), 0).astype(f32)
+    t_idx = jax.lax.broadcasted_iota(
+        jnp.int32, (s_total, TILE), 1).astype(f32)
+    bins = jnp.floor(s_idx / sampling)
+    off = (s_idx - bins * sampling + 0.5) / sampling
+    coord = start + (bins + off) * binsz
+    return jnp.maximum(0.0, 1.0 - jnp.abs(coord - t_idx))
+
+
 def _kernel(out_size: int, sampling: int, num_levels: int, align: int,
             # scalar prefetch (SMEM), one entry per ROI:
             lvl_ref, b_ref, y0_ref, x0_ref,   # int32 level/batch/origin
@@ -151,24 +175,10 @@ def _kernel(out_size: int, sampling: int, num_levels: int, align: int,
     bin_h = bh_ref[r]
     bin_w = bw_ref[r]
 
-    s_total = out_size * sampling
+    ry = _bilinear_weights(y_start, bin_h, out_size, sampling)  # [S, T]
+    cx = _bilinear_weights(x_start, bin_w, out_size, sampling)  # [S, T]
     f32 = jnp.float32
-
-    def weights(start, binsz):
-        """[S, T] two-tap bilinear weight matrix for sample coords
-        start + (bin + (j+0.5)/sampling) * binsz."""
-        # Mosaic's iota is integer-only; build int32 and convert
-        s_idx = jax.lax.broadcasted_iota(
-            jnp.int32, (s_total, TILE), 0).astype(f32)
-        t_idx = jax.lax.broadcasted_iota(
-            jnp.int32, (s_total, TILE), 1).astype(f32)
-        bins = jnp.floor(s_idx / sampling)
-        off = (s_idx - bins * sampling + 0.5) / sampling
-        coord = start + (bins + off) * binsz
-        return jnp.maximum(0.0, 1.0 - jnp.abs(coord - t_idx))
-
-    ry = weights(y_start, bin_h)                    # [S, T]
-    cx = weights(x_start, bin_w)                    # [S, T]
+    s_total = out_size * sampling
 
     tile = tile_ref[:].astype(f32)                  # [T, T, C]
     c = tile.shape[-1]
@@ -189,6 +199,85 @@ def _kernel(out_size: int, sampling: int, num_levels: int, align: int,
     pooled = sampled.reshape(out_size, sampling, out_size, sampling,
                              c).mean(axis=(1, 3))
     out_ref[0] = pooled.astype(out_ref.dtype)
+
+
+def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
+                align: int,
+                # scalar prefetch (SMEM), one entry per ROI:
+                lvl_ref, b_ref, y0_ref, x0_ref,
+                ys_ref, xs_ref, bh_ref, bw_ref,
+                *refs):
+    """Transpose of ``_kernel``: d_tile = RyPᵀ @ g @ CxP, accumulated
+    into the per-level gradient buffer by sequential RMW DMA."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g_ref = refs[0]                         # VMEM [1, out, out, C]
+    # refs[1 : 1+L] are the zero-initialized ANY inputs aliased to the
+    # outputs — unused directly; the RMW goes through the out refs
+    acc_refs = refs[1 + num_levels: 1 + 2 * num_levels]  # ANY outputs
+    acc_tile = refs[1 + 2 * num_levels]     # VMEM scratch [T, T, C] f32
+    sem = refs[1 + 2 * num_levels + 1]      # DMA semaphore
+
+    r = pl.program_id(0)
+    lvl = lvl_ref[r]
+    b = b_ref[r]
+    y0 = y0_ref[r]
+    x0 = x0_ref[r] * align                  # see _kernel: provable align
+
+    # read the current accumulation tile
+    for i in range(num_levels):
+        @pl.when(lvl == i)
+        def _(i=i):
+            dma = pltpu.make_async_copy(
+                acc_refs[i].at[b, pl.ds(y0, TILE), pl.ds(x0, TILE), :],
+                acc_tile, sem)
+            dma.start()
+            dma.wait()
+
+    y_start = ys_ref[r]
+    x_start = xs_ref[r]
+    bin_h = bh_ref[r]
+    bin_w = bw_ref[r]
+
+    f32 = jnp.float32
+
+    def pooled_weights(start, binsz):
+        """[out, T]: the fwd's weight matrix averaged over each bin's
+        ``sampling`` sample points (pooling is linear, so the sample
+        axis folds into the weights)."""
+        w = _bilinear_weights(start, binsz, out_size, sampling)  # [S, T]
+        return w.reshape(out_size, sampling, TILE).mean(axis=1)
+
+    ryp = pooled_weights(y_start, bin_h)                       # [out, T]
+    cxp = pooled_weights(x_start, bin_w)                       # [out, T]
+
+    g_tile = g_ref[0].astype(f32)                              # [o, o, C]
+    c = g_tile.shape[-1]
+    # rows: [T, out] @ [out, out*C] → [T, out, C]
+    rows = jnp.dot(ryp.T, g_tile.reshape(out_size, out_size * c),
+                   preferred_element_type=f32,
+                   precision=jax.lax.Precision.HIGHEST
+                   ).reshape(TILE, out_size, c)
+    # cols: contract out with cxp → [T, C, T] → [T, T, C]
+    d_tile = jax.lax.dot_general(
+        rows, cxp,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+        precision=jax.lax.Precision.HIGHEST).transpose(0, 2, 1)
+
+    acc_tile[:] = acc_tile[:] + d_tile
+
+    # write the updated tile back (sequential grid — no races)
+    for i in range(num_levels):
+        @pl.when(lvl == i)
+        def _(i=i):
+            dma = pltpu.make_async_copy(
+                acc_tile,
+                acc_refs[i].at[b, pl.ds(y0, TILE), pl.ds(x0, TILE), :],
+                sem)
+            dma.start()
+            dma.wait()
 
 
 def _prep(feats, rois, strides, out_size, min_level, align):
@@ -283,6 +372,97 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
     return out.reshape(b, n, out_size, out_size, c)
 
 
+def _pallas_backward(feats, rois, g, strides, out_size, sampling,
+                     min_level, interpret):
+    """Per-level feature gradients via the transpose kernel.  Returns
+    gradients in the feats' dtype (accumulation runs in f32)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    align = sublane_align(feats[0].dtype)
+    padded = _pad_levels(feats, align)
+    b, n = rois.shape[0], rois.shape[1]
+    c = padded[0].shape[-1]
+    scalars = _prep(padded, rois, strides, out_size, min_level, align)
+    num_levels = len(padded)
+    kern = functools.partial(_bwd_kernel, out_size, sampling,
+                             num_levels, align)
+
+    g_flat = g.reshape(b * n, out_size, out_size, c)
+    zeros = tuple(jnp.zeros(f.shape, jnp.float32) for f in padded)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=8,
+        grid=(b * n,),
+        in_specs=[pl.BlockSpec((1, out_size, out_size, c),
+                               lambda r, *_: (r, 0, 0, 0),
+                               memory_space=pltpu.VMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
+        scratch_shapes=[
+            pltpu.VMEM((TILE, TILE, c), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    outs = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=tuple(jax.ShapeDtypeStruct(f.shape, jnp.float32)
+                        for f in padded),
+        # zero-input i (flat arg index 8 scalars + 1 g + i) owns output
+        # buffer i: the accumulators start as zeros and the kernel RMWs
+        # them through the out refs
+        input_output_aliases={9 + i: i for i in range(num_levels)},
+        interpret=interpret,
+    )(*scalars, g_flat, *zeros)
+    return tuple(
+        o[:, :f.shape[1], :f.shape[2], :].astype(f.dtype)
+        for o, f in zip(outs, feats))
+
+
+_BWD_PROBE: dict = {}  # dtype → cached hardware compile-probe
+
+
+def _probe_bwd_compile(dtype) -> bool:
+    """Hardware compile-probe for the backward kernel (same rationale
+    as ``_probe_compile``: Mosaic can reject what interpret accepts)."""
+    try:
+        feats = tuple(jnp.zeros((1, max(TILE, 256 // s),
+                                 max(TILE, 256 // s), 256), dtype)
+                      for s in (4, 8, 16, 32))
+        rois = jnp.asarray([[[4.0, 4.0, 36.0, 36.0],
+                             [8.0, 8.0, 200.0, 120.0]]], jnp.float32)
+        g = jnp.ones((1, 2, 7, 7, 256), dtype)
+        out = _pallas_backward(feats, rois, g, (4, 8, 16, 32), 7, 2, 2,
+                               False)
+        jax.block_until_ready(out)
+        return all(bool(np.isfinite(np.asarray(o, np.float32)).all())
+                   for o in out)
+    except Exception as e:  # noqa: BLE001
+        log.warning("Pallas ROIAlign backward unavailable for %s "
+                    "(falling back to XLA): %s", np.dtype(dtype), e)
+        return False
+
+
+def pallas_roi_bwd_supported(dtype=jnp.float32) -> bool:
+    """Backward-kernel gate: ``EKSML_ROI_BWD={auto,pallas,xla}`` —
+    auto probes on real TPU (once per dtype), xla forces the gather
+    -transpose formulation, pallas forces the kernel."""
+    mode = os.environ.get("EKSML_ROI_BWD", "auto").lower()
+    if mode == "xla":
+        return False
+    if mode == "pallas":
+        return True
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    key = np.dtype(dtype).name
+    if key not in _BWD_PROBE:
+        _BWD_PROBE[key] = _probe_bwd_compile(dtype)
+    return _BWD_PROBE[key]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
 def pallas_batched_multilevel_roi_align(
         feats, rois, strides: Sequence[int], out_size: int,
@@ -290,7 +470,9 @@ def pallas_batched_multilevel_roi_align(
         interpret: bool = False):
     """Drop-in for ops.roi_align.batched_multilevel_roi_align:
     feats ``[(B, Hl, Wl, C), ...]``, rois ``[B, N, 4]`` →
-    ``[B, N, out, out, C]``.  Pallas forward, XLA backward."""
+    ``[B, N, out, out, C]``.  Pallas forward; backward is the
+    transpose Pallas kernel when enabled (``EKSML_ROI_BWD``, see
+    ``_bwd``) and the XLA formulation's VJP otherwise."""
     return _pallas_forward(tuple(feats), rois, strides, out_size,
                            sampling_ratio, min_level, interpret)
 
@@ -303,13 +485,20 @@ def _fwd(feats, rois, strides, out_size, sampling_ratio, min_level,
 
 
 def _bwd(strides, out_size, sampling_ratio, min_level, interpret, res, g):
-    """Backward through the XLA formulation with the SAME tile-fit level
-    assignment as the forward kernel (identical math; scatter-add grads
-    XLA handles well)."""
+    """Backward: the transpose Pallas kernel when enabled (two MXU
+    matmuls + sequential RMW accumulation, no scatter), else the XLA
+    formulation's VJP — both with the SAME tile-fit level assignment as
+    the forward kernel, so fwd/bwd never diverge."""
     from eksml_tpu.ops.roi_align import (assign_fpn_levels_tile_fit,
                                          batched_multilevel_roi_align)
 
     feats, rois = res
+    mode = os.environ.get("EKSML_ROI_BWD", "auto").lower()
+    if mode != "xla" and (interpret
+                          or pallas_roi_bwd_supported(feats[0].dtype)):
+        g_feats = _pallas_backward(feats, rois, g, strides, out_size,
+                                   sampling_ratio, min_level, interpret)
+        return g_feats, jnp.zeros_like(rois)
     b, n = rois.shape[0], rois.shape[1]
     levels = assign_fpn_levels_tile_fit(
         rois.reshape(b * n, 4), strides, len(feats), TILE,

@@ -132,3 +132,58 @@ def test_extreme_aspect_ratio_fwd_bwd_consistent():
         fs, rois, STRIDES, 7, levels=fit.reshape(1, 1)).sum())(feats)
     for a, b in zip(gp, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_bwd_accumulation_is_linear_in_duplicate_rois():
+    """N identical ROIs must deposit exactly N× one ROI's gradient —
+    the sharp test of the backward kernel's sequential RMW
+    accumulation into the shared tile region."""
+    rng = np.random.RandomState(6)
+    feats = _feats(rng, c=8)
+    one = _rois(rng, 1, 1)
+    four = jnp.tile(one, (1, 4, 1))
+
+    g1 = jax.grad(lambda fs: pallas_batched_multilevel_roi_align(
+        fs, one, STRIDES, 7, 2, 2, True).sum())(feats)
+    g4 = jax.grad(lambda fs: pallas_batched_multilevel_roi_align(
+        fs, four, STRIDES, 7, 2, 2, True).sum())(feats)
+    for a, b in zip(g4, g1):
+        np.testing.assert_allclose(np.asarray(a), 4 * np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_bwd_bf16_dtype_and_tolerance():
+    """bf16 features: gradient comes back in bf16 (f32 accumulation
+    inside) and tracks the f32 reference within bf16 resolution."""
+    rng = np.random.RandomState(7)
+    feats32 = _feats(rng, b=2, c=8)
+    feats16 = tuple(f.astype(jnp.bfloat16) for f in feats32)
+    rois = _rois(rng, 2, 6)
+
+    gp = jax.grad(lambda fs: pallas_batched_multilevel_roi_align(
+        fs, rois, STRIDES, 7, 2, 2, True).sum().astype(jnp.float32)
+        )(feats16)
+    gr = jax.grad(lambda fs: batched_multilevel_roi_align(
+        fs, rois, STRIDES, 7).sum())(feats32)
+    for a, b in zip(gp, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
+                                   np.asarray(b), atol=0.05, rtol=0.05)
+
+
+def test_bwd_env_override_forces_xla(monkeypatch):
+    """EKSML_ROI_BWD=xla must route interpret-mode grads through the
+    XLA formulation (and agree — both are the same linear map)."""
+    rng = np.random.RandomState(8)
+    feats = _feats(rng, c=8)
+    rois = _rois(rng, 1, 3)
+
+    monkeypatch.setenv("EKSML_ROI_BWD", "xla")
+    g_xla = jax.grad(lambda fs: pallas_batched_multilevel_roi_align(
+        fs, rois, STRIDES, 7, 2, 2, True).sum())(feats)
+    monkeypatch.setenv("EKSML_ROI_BWD", "auto")
+    g_pal = jax.grad(lambda fs: pallas_batched_multilevel_roi_align(
+        fs, rois, STRIDES, 7, 2, 2, True).sum())(feats)
+    for a, b in zip(g_xla, g_pal):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4)
