@@ -145,6 +145,11 @@ def main(argv=None):
                    choices=["auto", "pallas", "xla"],
                    help="A/B switch for the ROIAlign kernel "
                         "(sets EKSML_ROI_BACKEND)")
+    p.add_argument("--roi-bwd", default="auto",
+                   choices=["auto", "pallas", "xla"],
+                   help="A/B switch for the ROIAlign BACKWARD kernel "
+                        "(sets EKSML_ROI_BWD; only matters when the "
+                        "pallas forward is active)")
     p.add_argument("--init-retries", type=int, default=5)
     p.add_argument("--init-backoff", type=float, default=10.0,
                    help="first retry wait; doubles per attempt")
@@ -162,6 +167,7 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     os.environ["EKSML_ROI_BACKEND"] = args.roi_backend
+    os.environ["EKSML_ROI_BWD"] = args.roi_bwd
 
     diag = {
         "metric": "maskrcnn_r50fpn_train_throughput",
@@ -173,6 +179,7 @@ def main(argv=None):
                        else args.image_size),
         "precision": args.precision,
         "roi_backend": args.roi_backend,
+        "roi_bwd": args.roi_bwd,
     }
 
     try:

@@ -16,7 +16,9 @@ on a gather path the TPU executes poorly.  This kernel:
   per-ROI metadata — level/batch/origin indices and the float
   start/bin-size values — through SMEM.  (Putting the float info in a
   VMEM block would need a (1, 8) block shape, which Mosaic rejects:
-  the second-to-last block dim must be a multiple of 8.)
+  the second-to-last block dim must be a multiple of 8.)  Tile fetch
+  is DOUBLE-BUFFERED: ROI r+1's tile streams into the other slot while
+  ROI r's matmuls run, so the 2-4 MB/ROI DMA overlaps compute.
 
 Semantics notes:
 - matches ``aligned=True`` ROIAlign with zero padding outside the
@@ -72,6 +74,18 @@ def tile_margin(dtype) -> int:
     return 3 + sublane_align(dtype) - 1
 
 
+def _probe_fixture(dtype):
+    """ONE probe fixture for fwd and bwd: production shape class —
+    4 FPN levels, C=256 (fpn.py) — so the multi-level @pl.when DMA
+    selection and full scratch size must compile, not just a toy
+    single-level variant."""
+    feats = tuple(jnp.zeros((1, max(TILE, 256 // s), max(TILE, 256 // s),
+                             256), dtype) for s in (4, 8, 16, 32))
+    rois = jnp.asarray([[[4.0, 4.0, 36.0, 36.0],
+                         [8.0, 8.0, 200.0, 120.0]]], jnp.float32)
+    return feats, rois
+
+
 def _probe_compile(dtype) -> bool:
     """Compile + run the kernel once on tiny real shapes OF THE
     PRODUCTION DTYPE.  The Mosaic compiler is versioned independently
@@ -80,13 +94,7 @@ def _probe_compile(dtype) -> bool:
     bench time), and bf16 memrefs have different tiling constraints
     than f32 — probe what will actually run."""
     try:
-        # production shape class: 4 FPN levels, C=256 (fpn.py) — the
-        # multi-level @pl.when DMA selection and full scratch size must
-        # compile, not just a toy single-level variant
-        feats = tuple(jnp.zeros((1, max(TILE, 256 // s), max(TILE, 256 // s),
-                                 256), dtype) for s in (4, 8, 16, 32))
-        rois = jnp.asarray([[[4.0, 4.0, 36.0, 36.0],
-                             [8.0, 8.0, 200.0, 120.0]]], jnp.float32)
+        feats, rois = _probe_fixture(dtype)
         out = pallas_batched_multilevel_roi_align(
             feats, rois, (4, 8, 16, 32), 7, 2, 2)
         jax.block_until_ready(out)
@@ -98,12 +106,10 @@ def _probe_compile(dtype) -> bool:
         return False
 
 
-def pallas_roi_align_supported(dtype=jnp.float32) -> bool:
-    """True when the kernel path should be used: real TPU backend AND
-    the kernel compiles there for ``dtype`` (probed once per dtype,
-    cached).  Overridable via ``EKSML_ROI_BACKEND={auto,pallas,xla}``
-    — the A/B switch bench.py exposes as ``--roi-backend``."""
-    mode = os.environ.get("EKSML_ROI_BACKEND", "auto").lower()
+def _gate(env_var: str, dtype, cache: dict, probe) -> bool:
+    """Shared kernel gate: env override (xla/pallas) → else require a
+    real TPU backend and a successful once-per-dtype hardware probe."""
+    mode = os.environ.get(env_var, "auto").lower()
     if mode == "xla":
         return False
     if mode == "pallas":
@@ -114,9 +120,17 @@ def pallas_roi_align_supported(dtype=jnp.float32) -> bool:
     except Exception:
         return False
     key = np.dtype(dtype).name
-    if key not in _PROBE_RESULTS:
-        _PROBE_RESULTS[key] = _probe_compile(dtype)
-    return _PROBE_RESULTS[key]
+    if key not in cache:
+        cache[key] = probe(dtype)
+    return cache[key]
+
+
+def pallas_roi_align_supported(dtype=jnp.float32) -> bool:
+    """True when the forward kernel path should be used
+    (``EKSML_ROI_BACKEND={auto,pallas,xla}`` — the A/B switch bench.py
+    exposes as ``--roi-backend``)."""
+    return _gate("EKSML_ROI_BACKEND", dtype, _PROBE_RESULTS,
+                 _probe_compile)
 
 
 def _bilinear_weights(start, binsz, out_size: int, sampling: int):
@@ -148,27 +162,46 @@ def _kernel(out_size: int, sampling: int, num_levels: int, align: int,
 
     feat_refs = refs[:num_levels]          # HBM [B, Hp, Wp, C] each
     out_ref = refs[num_levels]             # VMEM [1, out, out, C]
-    tile_ref = refs[num_levels + 1]        # VMEM scratch [T, T, C]
-    sem = refs[num_levels + 2]             # DMA semaphore
+    tiles_ref = refs[num_levels + 1]       # VMEM scratch [2, T, T, C]
+    sems = refs[num_levels + 2]            # DMA semaphores (2,)
 
     r = pl.program_id(0)
-    lvl = lvl_ref[r]
-    b = b_ref[r]
-    y0 = y0_ref[r]
-    # x0 arrives as a sublane-block count; multiplying by the dtype's
-    # sublane alignment (8 for f32 tiles (8,128), 16 for bf16 (16,128))
-    # here lets Mosaic PROVE the W-dim slice origin is aligned (its
-    # HBM-slice tiling requirement — an SMEM value alone is unprovable)
-    x0 = x0_ref[r] * align
+    n = pl.num_programs(0)
 
-    for i in range(num_levels):
-        @pl.when(lvl == i)
-        def _(i=i):
-            dma = pltpu.make_async_copy(
-                feat_refs[i].at[b, pl.ds(y0, TILE), pl.ds(x0, TILE), :],
-                tile_ref, sem)
-            dma.start()
-            dma.wait()
+    # Double-buffered tile fetch: while ROI r's matmuls run, ROI r+1's
+    # tile streams into the other slot — the per-ROI DMA (4 MB f32 /
+    # 2 MB bf16) stops serializing with compute.  Slot parity keeps the
+    # in-flight DMA and the live compute on different buffers; the grid
+    # is sequential per core, so step r's body starts only after step
+    # r-1's compute retired.
+    def _dma(slot, idx, op):
+        lv = lvl_ref[idx]
+        bb = b_ref[idx]
+        yy = y0_ref[idx]
+        # x0 arrives as a sublane-block count; multiplying by the
+        # dtype's sublane alignment (8 for f32 tiles (8,128), 16 for
+        # bf16 (16,128)) here lets Mosaic PROVE the W-dim slice origin
+        # is aligned (its HBM-slice tiling requirement — an SMEM value
+        # alone is unprovable)
+        xx = x0_ref[idx] * align
+        for i in range(num_levels):
+            @pl.when(lv == i)
+            def _(i=i):
+                op(pltpu.make_async_copy(
+                    feat_refs[i].at[bb, pl.ds(yy, TILE),
+                                    pl.ds(xx, TILE), :],
+                    tiles_ref.at[slot], sems.at[slot]))
+
+    @pl.when(r == 0)
+    def _():
+        _dma(0, 0, lambda d: d.start())
+
+    @pl.when(r + 1 < n)
+    def _():
+        _dma((r + 1) % 2, r + 1, lambda d: d.start())
+
+    _dma(r % 2, r, lambda d: d.wait())
+    tile_ref = tiles_ref.at[r % 2]
 
     y_start = ys_ref[r]
     x_start = xs_ref[r]
@@ -358,8 +391,8 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
                                lambda r, *_: (r, 0, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((TILE, TILE, c), feats[0].dtype),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.VMEM((2, TILE, TILE, c), feats[0].dtype),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     out = pl.pallas_call(
@@ -424,13 +457,10 @@ _BWD_PROBE: dict = {}  # dtype → cached hardware compile-probe
 
 def _probe_bwd_compile(dtype) -> bool:
     """Hardware compile-probe for the backward kernel (same rationale
-    as ``_probe_compile``: Mosaic can reject what interpret accepts)."""
+    and fixture as ``_probe_compile``: Mosaic can reject what
+    interpret accepts)."""
     try:
-        feats = tuple(jnp.zeros((1, max(TILE, 256 // s),
-                                 max(TILE, 256 // s), 256), dtype)
-                      for s in (4, 8, 16, 32))
-        rois = jnp.asarray([[[4.0, 4.0, 36.0, 36.0],
-                             [8.0, 8.0, 200.0, 120.0]]], jnp.float32)
+        feats, rois = _probe_fixture(dtype)
         g = jnp.ones((1, 2, 7, 7, 256), dtype)
         out = _pallas_backward(feats, rois, g, (4, 8, 16, 32), 7, 2, 2,
                                False)
@@ -447,20 +477,7 @@ def pallas_roi_bwd_supported(dtype=jnp.float32) -> bool:
     """Backward-kernel gate: ``EKSML_ROI_BWD={auto,pallas,xla}`` —
     auto probes on real TPU (once per dtype), xla forces the gather
     -transpose formulation, pallas forces the kernel."""
-    mode = os.environ.get("EKSML_ROI_BWD", "auto").lower()
-    if mode == "xla":
-        return False
-    if mode == "pallas":
-        return True
-    try:
-        if jax.default_backend() != "tpu":
-            return False
-    except Exception:
-        return False
-    key = np.dtype(dtype).name
-    if key not in _BWD_PROBE:
-        _BWD_PROBE[key] = _probe_bwd_compile(dtype)
-    return _BWD_PROBE[key]
+    return _gate("EKSML_ROI_BWD", dtype, _BWD_PROBE, _probe_bwd_compile)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))

@@ -33,12 +33,17 @@ if [ "$WAIT_HEADLINE" = "1" ]; then
 fi
 
 # ROIAlign A/B on hardware (VERDICT r2 next #2): square canvas and the
-# 832x1344 bucket canvas, pallas vs xla.  Short runs; the compile for
+# 832x1344 bucket canvas, pallas vs xla, plus the backward-kernel A/B
+# (pallas fwd fixed, bwd pallas vs xla).  Short runs; the compile for
 # each variant is paid once into .jax_cache.
-run_bench roi_ab_pallas_1344   --steps 10 --roi-backend pallas
-run_bench roi_ab_xla_1344      --steps 10 --roi-backend xla
-run_bench roi_ab_pallas_832x1344 --steps 10 --roi-backend pallas --pad-hw 832 1344
-run_bench roi_ab_xla_832x1344  --steps 10 --roi-backend xla --pad-hw 832 1344
+# fwd A/B pins --roi-bwd xla so the forward kernel is the ONLY
+# variable; the bwd pair then varies only the backward
+run_bench roi_ab_pallas_1344   --steps 10 --roi-backend pallas --roi-bwd xla
+run_bench roi_ab_xla_1344      --steps 10 --roi-backend xla --roi-bwd xla
+run_bench roi_ab_pallas_832x1344 --steps 10 --roi-backend pallas --roi-bwd xla --pad-hw 832 1344
+run_bench roi_ab_xla_832x1344  --steps 10 --roi-backend xla --roi-bwd xla --pad-hw 832 1344
+# bwd A/B: compare against roi_ab_pallas_1344 (pallas fwd + xla bwd)
+run_bench roi_ab_bwd_pallas_1344 --steps 10 --roi-backend pallas --roi-bwd pallas
 python - <<'EOF'
 import json, glob
 out = []
@@ -50,8 +55,8 @@ for p in sorted(glob.glob("artifacts/roi_ab_*.json")):
     except Exception:
         continue
     out.append({"run": p.split("/")[-1][:-5], **{k: d.get(k) for k in (
-        "value", "step_time_ms", "mfu", "roi_backend", "image_size",
-        "error")}})
+        "value", "step_time_ms", "mfu", "roi_backend", "roi_bwd",
+        "image_size", "error")}})
 json.dump({"runs": out}, open("artifacts/roi_ab_r3.json", "w"), indent=1)
 print("merged", len(out), "runs into artifacts/roi_ab_r3.json")
 EOF
