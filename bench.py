@@ -330,7 +330,10 @@ def run(args, diag: dict) -> None:
         mfu = flops_per_step / (dt / args.steps) / (peak * n_dev)
         diag["mfu"] = round(mfu, 4)
         diag["tflops_per_step"] = round(flops_per_step / 1e12, 2)
-    if diag["value"] > 0:
+    # bank HARDWARE evidence only: a CPU smoke overwriting the banked
+    # TPU number would defeat the feature (the stale record a failure
+    # cites must be a real accelerator measurement)
+    if diag["value"] > 0 and dev_kind.lower() not in ("cpu", "host"):
         _bank_last_good(diag)
     _emit(diag)
 
