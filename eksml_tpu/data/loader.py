@@ -250,6 +250,8 @@ class DetectionLoader:
                       pad_hw: Optional[Tuple[int, int]] = None,
                       image: Optional[np.ndarray] = None
                       ) -> Dict[str, np.ndarray]:
+        if image is not None and hasattr(image, "result"):
+            image = image.result()  # process-pool decode future
         if image is None:
             if rec.get("_image") is not None:
                 image = rec["_image"]
@@ -432,14 +434,16 @@ class DetectionLoader:
                     pad_hw, idx = self._next_bucket_batch()
                     recs = [self.records[i] for i in idx]
                     draws = [self._draw() for _ in idx]
+                    # futures pass through to _load_example so each
+                    # augment thread waits only on ITS record's decode
+                    # — decode and resize/augment overlap instead of
+                    # running as serial per-batch stages
                     images = [None] * len(recs)
                     if proc_pool is not None:
-                        futs = {
-                            i: proc_pool.submit(load_image, r["path"])
-                            for i, r in enumerate(recs)
-                            if r.get("_image") is None}
-                        for i, fut in futs.items():
-                            images[i] = fut.result()
+                        for i, r in enumerate(recs):
+                            if r.get("_image") is None:
+                                images[i] = proc_pool.submit(
+                                    load_image, r["path"])
                     if pool is not None:
                         exs = list(pool.map(
                             self._load_example, recs,

@@ -36,6 +36,16 @@ initialize_from_env()
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 8, len(jax.devices())
 
+import jax.numpy as jnp
+
+from eksml_tpu.parallel import cross_host_sum
+
+# Establish the Gloo clique NOW, while both ranks are aligned from the
+# rendezvous barrier.  Gloo pairs connect lazily at the first
+# collective with a fixed ~30s deadline; on a loaded 1-core CI box the
+# first in-training collective can find the peer starved past it.
+cross_host_sum({"warmup": jnp.zeros(())})
+
 import numpy as np
 from eksml_tpu.config import (SMOKE_OVERRIDES, config as cfg,
                               finalize_configs)

@@ -49,7 +49,11 @@ try:
     d = json.loads(sys.argv[1])
 except Exception:
     sys.exit(1)
-sys.exit(0 if d.get("value", 0) > 0 else 1)' "$out"
+# hardware evidence only: a CPU-fallback backend must not declare the
+# headline landed (and must not unleash the harvest chain on CPU)
+ok = d.get("value", 0) > 0 and \
+    d.get("device_kind", "").lower() not in ("", "cpu", "host")
+sys.exit(0 if ok else 1)' "$out"
     then
         echo "$out" > BENCH_LOCAL.json
         echo "[loop] success on attempt $i" >> bench_loop.log

@@ -1,0 +1,102 @@
+"""Offline COCO evaluation of a training checkpoint.
+
+The CLI twin of the inference notebook (reference role:
+container-viz notebook's ckpt-discovery → predict path) and the rerun
+path for any banked run: point it at a training ``--logdir`` and a
+dataset, it restores the latest (or ``--step``) Orbax checkpoint and
+runs the distributed-capable evaluator on the requested split.
+
+Usage::
+
+    python tools/eval_ckpt.py --logdir /tmp/run --data <basedir> \
+        [--split val2017] [--max-images N] [--out results.json] \
+        [--platform cpu] [--config KEY=VALUE ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--logdir", required=True)
+    p.add_argument("--data", required=True, help="COCO-layout basedir")
+    p.add_argument("--split", default="val2017")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: latest)")
+    p.add_argument("--max-images", type=int, default=None)
+    p.add_argument("--out", default=None)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--config", nargs="*", default=[],
+                   help="KEY=VALUE overrides — must match the "
+                        "training run's model architecture")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from eksml_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    from eksml_tpu.config import config as cfg
+    from eksml_tpu.config import finalize_configs
+    from eksml_tpu.data import CocoDataset
+    from eksml_tpu.data.loader import make_synthetic_batch
+    from eksml_tpu.evalcoco import run_evaluation
+    from eksml_tpu.train import Trainer
+
+    cfg.freeze(False)
+    cfg.DATA.BASEDIR = args.data
+    cfg.TRAIN.LOGDIR = args.logdir
+    cfg.update_args(args.config)
+    finalize_configs(is_training=True)  # trainer state incl. optimizer
+
+    trainer = Trainer(cfg, args.logdir)
+    latest = trainer.ckpt.latest_step()
+    if latest is None:
+        print("eval_ckpt: no checkpoint found under "
+              f"{args.logdir}/checkpoints", file=sys.stderr)
+        return 1
+    at_step = latest if args.step is None else args.step
+    example = make_synthetic_batch(cfg, batch_size=1,
+                                   image_size=cfg.PREPROC.MAX_SIZE)
+    # init builds the restore template; exactly ONE checkpoint read
+    state = trainer.init_state(trainer._globalize_batch(example))
+    try:
+        state = trainer.ckpt.restore(state, step=at_step)
+    except Exception as e:  # noqa: BLE001 — pruned/missing step
+        print(f"eval_ckpt: restore of step {at_step} failed "
+              f"({type(e).__name__}: {e}); available: "
+              f"{os.listdir(trainer.ckpt.directory)}", file=sys.stderr)
+        return 1
+
+    records = CocoDataset(args.data, args.split).records(skip_empty=False)
+    t0 = time.time()
+    results = run_evaluation(trainer.model, state.params, cfg, records,
+                             max_images=args.max_images)
+    payload = {"logdir": args.logdir, "step": int(at_step),
+               "split": args.split,
+               "num_images": (min(args.max_images, len(records))
+                              if args.max_images else len(records)),
+               "eval_seconds": round(time.time() - t0, 1),
+               **{k: round(float(v), 4) for k, v in results.items()}}
+    print(json.dumps(payload))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

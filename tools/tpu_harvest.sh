@@ -65,7 +65,10 @@ say "A/B merged into artifacts/roi_ab_r3.json"
 # Train-step profile (VERDICT r2 next #5): decide the Pallas-backward
 # go/no-go on a real trace.
 run_bench bench_profiled --steps 10 --profile 8
-python tools/trace_summary.py profile \
-    --out artifacts/profile_summary_r3.json >> "$LOG" 2>&1
-say "profile summary banked"
+if python tools/trace_summary.py profile \
+    --out artifacts/profile_summary_r3.json >> "$LOG" 2>&1; then
+    say "profile summary banked"
+else
+    say "profile summary FAILED — see above; trace left in ./profile"
+fi
 say "harvest complete"

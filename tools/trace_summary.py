@@ -87,6 +87,11 @@ def summarize(trace_dir: str, top_n: int = 15) -> dict:
         else:
             fam_us["other"] = fam_us.get("other", 0.0) + dur
 
+    if total == 0:
+        raise ValueError(
+            f"no device-lane events found in {path!r} (pids matched: "
+            f"{sorted(device_pids)}) — truncated capture or unexpected "
+            "lane naming")
     fam_pct = {k: round(100 * v / total, 2)
                for k, v in sorted(fam_us.items(), key=lambda kv: -kv[1])}
     top_ops = [{"name": k, "us": round(v, 1),
@@ -105,8 +110,9 @@ def main(argv=None):
     args = p.parse_args(argv)
     try:
         summary = summarize(args.trace_dir, args.top)
-    except FileNotFoundError as e:
-        print(json.dumps({"error": str(e)}))
+    except (FileNotFoundError, ValueError, OSError,
+            json.JSONDecodeError) as e:
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
         return 1
     out = json.dumps(summary, indent=1)
     print(out)
