@@ -121,7 +121,16 @@ def _gate(env_var: str, dtype, cache: dict, probe) -> bool:
         return False
     key = np.dtype(dtype).name
     if key not in cache:
-        cache[key] = probe(dtype)
+        # The gate is usually reached MID-TRACE (the model queries it
+        # while its forward is being jitted).  Under omnistaging every
+        # op the probe runs — even on its own concrete fixture arrays —
+        # would be staged into the caller's jaxpr, so np.asarray(out)
+        # raised TracerArrayConversionError, the blanket except caught
+        # it, and every auto-mode run silently demoted to XLA on real
+        # hardware.  Escape the trace so the probe compiles and RUNS
+        # eagerly, exactly as it does outside jit.
+        with jax.ensure_compile_time_eval():
+            cache[key] = probe(dtype)
     return cache[key]
 
 

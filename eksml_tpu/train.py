@@ -131,7 +131,8 @@ def make_optimizer(cfg):
 class Trainer:
     """Owns mesh, model, state, loop. One instance per host process."""
 
-    def __init__(self, cfg, logdir: str, eval_fn=None):
+    def __init__(self, cfg, logdir: str, eval_fn=None,
+                 write_metrics: bool = True):
         self.cfg = cfg
         self.logdir = logdir
         self.eval_fn = eval_fn
@@ -160,8 +161,11 @@ class Trainer:
                                tuple(cfg.TPU.MESH_AXES))
         self.model = MaskRCNN.from_config(cfg)
         self.tx, self.sched = make_optimizer(cfg)
+        # write_metrics=False gives read-only consumers (eval_ckpt) a
+        # Trainer that never touches the run's metrics.jsonl/TB events
         self.writer = (MetricWriter(logdir)
-                       if jax.process_index() == 0 else None)
+                       if write_metrics and jax.process_index() == 0
+                       else None)
         self.ckpt = CheckpointManager(logdir)
 
         self._batch_sharding = batch_sharding(self.mesh)

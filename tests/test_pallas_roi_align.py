@@ -187,3 +187,39 @@ def test_bwd_env_override_forces_xla(monkeypatch):
     for a, b in zip(g_xla, g_pal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4)
+
+
+def test_gate_probe_survives_mid_trace(monkeypatch):
+    """The gate is reached while the model forward is being JITTED
+    (ops/roi_align.py:189).  Under omnistaging the probe's own ops were
+    staged into the caller's trace, np.asarray(out) raised
+    TracerArrayConversionError, and the blanket except silently demoted
+    every auto-mode run to XLA on real hardware (observed on the round-3
+    bench).  _gate must escape the trace so the probe runs eagerly."""
+    from eksml_tpu.ops.pallas import roi_align_kernel as rk
+
+    probe_calls = []
+
+    def fake_probe(dtype):
+        # the exact pattern the real probes use: build concrete inputs,
+        # run a computation, pull the result back to host numpy — which
+        # only works mid-trace if _gate escaped the trace
+        out = jnp.ones((2, 2), dtype) * 3.0
+        val = bool(np.isfinite(np.asarray(out, np.float32)).all())
+        probe_calls.append(val)
+        return val
+
+    monkeypatch.setattr(rk.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("EKSML_ROI_BACKEND", raising=False)
+    cache = {}
+
+    @jax.jit
+    def traced(x):
+        ok = rk._gate("EKSML_ROI_BACKEND", jnp.float32, cache,
+                      fake_probe)
+        return x + (1.0 if ok else 0.0)
+
+    res = traced(jnp.zeros(()))
+    assert probe_calls == [True]
+    assert cache == {"float32": True}
+    assert float(res) == 1.0

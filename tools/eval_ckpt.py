@@ -58,10 +58,17 @@ def main(argv=None):
     cfg.freeze(False)
     cfg.DATA.BASEDIR = args.data
     cfg.TRAIN.LOGDIR = args.logdir
+    # the checkpoint supplies every param; loading the pretrained npz
+    # (a training-box path) would be wasted I/O and crashes eval boxes
+    # that don't have it.  Cleared BEFORE update_args so an explicit
+    # --config BACKBONE.WEIGHTS=... still wins (convergence_run.py
+    # orders it the same way).
+    cfg.BACKBONE.WEIGHTS = ""
     cfg.update_args(args.config)
     finalize_configs(is_training=True)  # trainer state incl. optimizer
 
-    trainer = Trainer(cfg, args.logdir)
+    # read-only: never append to the run's metrics.jsonl / TB events
+    trainer = Trainer(cfg, args.logdir, write_metrics=False)
     latest = trainer.ckpt.latest_step()
     if latest is None:
         print("eval_ckpt: no checkpoint found under "

@@ -110,8 +110,7 @@ def main(argv=None):
     args = p.parse_args(argv)
     try:
         summary = summarize(args.trace_dir, args.top)
-    except (FileNotFoundError, ValueError, OSError,
-            json.JSONDecodeError) as e:
+    except (FileNotFoundError, ValueError, OSError) as e:
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
         return 1
     out = json.dumps(summary, indent=1)
