@@ -276,6 +276,14 @@ def _define_defaults() -> None:
     _C.TPU.COORDINATOR_ADDRESS = ""   # JobSet headless-service DNS
     _C.TPU.NUM_PROCESSES = 1
     _C.TPU.PROCESS_ID = 0
+    # Multi-slice (Multislice/DCN) data parallelism: number of v5e
+    # slices the data axis spans.  1 = single slice (parity scope —
+    # the reference's 2-node NCCL-over-TCP layout is ONE slice's ICI
+    # here); >1 orders the mesh slice-major so gradient all-reduce
+    # decomposes into ICI within each slice + one DCN hop between
+    # slices (parallel/mesh.py build_mesh).  Auto-detected from
+    # device.slice_index on real multi-slice deployments.
+    _C.TPU.NUM_SLICES = 1
 
     _C.freeze()
 

@@ -11,7 +11,8 @@ every host runs the same program, the Mesh defines parallelism.
 """
 
 from eksml_tpu.parallel.mesh import (  # noqa: F401
-    build_mesh, validate_topology, batch_sharding, replicated_sharding)
+    build_mesh, validate_topology, batch_sharding, replicated_sharding,
+    slice_groups, topology_label)
 from eksml_tpu.parallel.distributed import (  # noqa: F401
     initialize_from_env, process_count, process_index)
 from eksml_tpu.parallel.collectives import (  # noqa: F401

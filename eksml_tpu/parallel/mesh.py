@@ -97,20 +97,95 @@ def validate_topology(topology: str = "", num_chips: Optional[int] = None,
     return num_chips, hosts
 
 
+def slice_groups(devices) -> Optional[dict]:
+    """Group devices by hardware slice (``device.slice_index``, present
+    on multi-slice TPU deployments).  Returns ``{slice_index: [device]}``
+    ordered by slice index, or ``None`` when the platform exposes no
+    slice information (single slice, CPU, virtual devices)."""
+    groups: dict = {}
+    for d in devices:
+        idx = getattr(d, "slice_index", None)
+        if idx is None:
+            return None
+        groups.setdefault(idx, []).append(d)
+    if len(groups) <= 1:
+        return None
+    return {k: groups[k] for k in sorted(groups)}
+
+
 def build_mesh(mesh_shape: Sequence[int] = (),
                axis_names: Sequence[str] = ("data", "model"),
-               devices=None) -> Mesh:
+               devices=None, num_slices: int = 1) -> Mesh:
     """Build the training mesh.
 
     Default shape: all devices on the ``data`` axis, ``model`` axis 1 —
     the DP layout that matches the reference's only strategy
     (SURVEY.md §2c), with the model axis reserved for TP growth.
+
+    Multi-slice (``num_slices > 1`` or hardware ``slice_index``
+    present): devices are ordered SLICE-MAJOR before the reshape, so
+    the leading (data) axis decomposes as [slice0 | slice1 | ...] and
+    the trailing axes (model/TP) always stay inside one slice.  Batch
+    sharding and the gradient psum are unchanged — XLA lowers the
+    all-reduce over the data axis hierarchically: reduce-scatter /
+    all-gather on ICI within each slice, one small all-reduce over
+    **DCN** between slices (SURVEY.md §5.8 — this is the NCCL
+    inter-node TCP ring's TPU-native replacement; the reference's
+    2-node × 8-GPU layout maps to 2 slices of one v5e host each).
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if not mesh_shape:
         mesh_shape = (n,) + (1,) * (len(axis_names) - 1)
     need = int(np.prod(mesh_shape))
+    groups = slice_groups(devices)
+    if groups is not None:
+        # always order slice-major so any subset is slice-contiguous
+        devices = [d for g in groups.values() for d in g]
+        if need < n:
+            # subset smoke mesh on multi-slice hardware: keep it inside
+            # ONE slice (the first); a straddling subset would put a
+            # DCN hop inside what the mesh labels a single slice
+            first = len(next(iter(groups.values())))
+            if num_slices > 1 or need > first:
+                raise ValueError(
+                    f"subset mesh ({need} of {n} devices) on "
+                    f"multi-slice hardware must fit one slice "
+                    f"({first} devices) and be single-slice")
+            num_slices = 1
+        else:
+            if num_slices not in (1, len(groups)):
+                raise ValueError(
+                    f"num_slices={num_slices} contradicts hardware "
+                    f"slice count {len(groups)}")
+            sizes = {len(g) for g in groups.values()}
+            if len(sizes) != 1:
+                # uneven groups (a partial device subset was passed):
+                # slice boundaries would not line up with the data axis
+                raise ValueError(
+                    f"slices contribute unequal device counts "
+                    f"{sorted(len(g) for g in groups.values())}; pass "
+                    f"whole slices")
+            num_slices = len(groups)
+    elif num_slices > 1:
+        # no hardware slice info (CPU simulation / single-slice
+        # backend): emulate with equal contiguous blocks so multi-slice
+        # code paths are testable on a virtual-device mesh
+        if n % num_slices:
+            raise ValueError(
+                f"{n} devices do not split into num_slices={num_slices}")
+    if num_slices > 1:
+        # slice-major ordering only lines up with the mesh when the
+        # data axis splits evenly into whole slices and every device
+        # participates (a subset mesh could straddle a slice boundary)
+        if need != n:
+            raise ValueError(
+                f"multi-slice mesh must cover all {n} devices "
+                f"(shape {tuple(mesh_shape)} covers {need})")
+        if mesh_shape[0] % num_slices:
+            raise ValueError(
+                f"data axis {mesh_shape[0]} does not split over "
+                f"{num_slices} slices")
     if need > n:
         raise ValueError(
             f"mesh shape {tuple(mesh_shape)} needs {need} devices, "

@@ -158,7 +158,8 @@ class Trainer:
                                      if cfg.TRAIN.NUM_CHIPS > 1 else None),
                           chips_per_host=cfg.TRAIN.CHIPS_PER_HOST)
         self.mesh = build_mesh(tuple(cfg.TPU.MESH_SHAPE),
-                               tuple(cfg.TPU.MESH_AXES))
+                               tuple(cfg.TPU.MESH_AXES),
+                               num_slices=cfg.TPU.NUM_SLICES)
         self.model = MaskRCNN.from_config(cfg)
         self.tx, self.sched = make_optimizer(cfg)
         # write_metrics=False gives read-only consumers (eval_ckpt) a

@@ -97,3 +97,99 @@ def test_v5e_inventory_consistent():
     for name, (chips, hosts) in V5E_TOPOLOGIES.items():
         assert chips == int(name.split("-")[1])
         assert chips == hosts * 4 or chips < 4
+
+
+# ---- multi-slice (DCN) mesh --------------------------------------------
+
+
+def test_multislice_emulated_mesh_slice_major_order():
+    """num_slices=2 on 8 virtual devices: the data axis must decompose
+    into contiguous whole-slice blocks (slice-major order), so model/TP
+    axes can never straddle a DCN boundary."""
+    from eksml_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(num_slices=2)
+    assert mesh.devices.shape == (8, 1)
+    devs = list(mesh.devices.ravel())
+    assert devs == jax.devices()  # contiguous equal blocks, in order
+
+
+def test_multislice_mesh_validation():
+    from eksml_tpu.parallel.mesh import build_mesh
+
+    with pytest.raises(ValueError, match="do not split"):
+        build_mesh(num_slices=3)  # 8 % 3
+    with pytest.raises(ValueError, match="cover all"):
+        build_mesh(mesh_shape=(4, 1), num_slices=2)  # subset mesh
+    with pytest.raises(ValueError, match="data axis"):
+        build_mesh(mesh_shape=(2, 4), num_slices=4,
+                   axis_names=("data", "model"))
+
+
+def test_multislice_grad_matches_single_slice():
+    """The DP contract is unchanged across slices: same gradient as the
+    single-mesh layout, params stay replicated — XLA decides which hops
+    ride ICI vs DCN; numerics must not change."""
+    from eksml_tpu.parallel.mesh import build_mesh
+
+    def loss(w, x):
+        return jnp.mean((x @ w) ** 2)
+
+    x_host = np.arange(32.0).reshape(8, 4).astype(np.float32)
+    grads = []
+    for n_slices in (1, 2, 4):
+        mesh = build_mesh(num_slices=n_slices)
+        w = jax.device_put(jnp.ones((4,)), replicated_sharding(mesh))
+        x = jax.device_put(jnp.asarray(x_host), batch_sharding(mesh))
+        g = jax.jit(jax.grad(loss))(w, x)
+        assert g.sharding.is_fully_replicated
+        grads.append(np.asarray(g))
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-6)
+    np.testing.assert_allclose(grads[0], grads[2], rtol=1e-6)
+
+
+def test_slice_groups_hardware_attr():
+    """Devices exposing slice_index are grouped and ordered by it;
+    platforms without the attribute return None (single slice)."""
+    from eksml_tpu.parallel.mesh import slice_groups
+
+    class Dev:
+        def __init__(self, i, s):
+            self.id, self.slice_index = i, s
+
+        def __repr__(self):
+            return f"Dev({self.id},s{self.slice_index})"
+
+    devs = [Dev(0, 1), Dev(1, 0), Dev(2, 1), Dev(3, 0)]
+    groups = slice_groups(devs)
+    assert list(groups) == [0, 1]
+    assert [d.id for d in groups[0]] == [1, 3]
+    assert [d.id for d in groups[1]] == [0, 2]
+    assert slice_groups(jax.devices()) is None  # CPU: no slice_index
+    assert slice_groups([Dev(0, 0), Dev(1, 0)]) is None  # single slice
+
+
+def test_multislice_hardware_groups_validation():
+    """Hardware-path guards (stub devices carrying slice_index): the
+    validation runs before Mesh construction, so error paths are
+    testable without real multi-slice hardware."""
+    from eksml_tpu.parallel.mesh import build_mesh
+
+    class Dev:
+        def __init__(self, i, s):
+            self.id, self.slice_index = i, s
+
+    # uneven groups (partial subset of slice 1 passed): must refuse
+    uneven = [Dev(0, 0), Dev(1, 0), Dev(2, 1)]
+    with pytest.raises(ValueError, match="unequal device counts"):
+        build_mesh(mesh_shape=(3, 1), devices=uneven)
+
+    even = [Dev(0, 0), Dev(1, 0), Dev(2, 1), Dev(3, 1)]
+    # subset mesh must fit inside one slice and stay single-slice
+    with pytest.raises(ValueError, match="fit one slice"):
+        build_mesh(mesh_shape=(3, 1), devices=even)
+    with pytest.raises(ValueError, match="fit one slice"):
+        build_mesh(mesh_shape=(2, 1), devices=even, num_slices=2)
+    # num_slices contradicting the hardware count
+    with pytest.raises(ValueError, match="contradicts hardware"):
+        build_mesh(mesh_shape=(4, 1), devices=even, num_slices=3)
