@@ -49,6 +49,18 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload))
 
 
+def _is_hbm_oom(e: BaseException) -> bool:
+    """XLA:TPU compile-time out-of-memory (an operating-point problem —
+    retryable with remat — not a tunnel problem).  A bare
+    RESOURCE_EXHAUSTED is NOT enough: the tunnel uses gRPC, whose
+    quota/message-size transients carry the same status and must not
+    trigger a remat-degraded headline."""
+    msg = str(e)
+    return ("Ran out of memory in memory space hbm" in msg
+            or ("RESOURCE_EXHAUSTED" in msg
+                and ("hbm" in msg.lower() or "allocat" in msg.lower())))
+
+
 LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "artifacts", "bench_last_good.json")
 
@@ -187,10 +199,38 @@ def main(argv=None):
     except Exception as e:  # noqa: BLE001 — diagnostic line must land
         import traceback
 
-        diag["error"] = f"{type(e).__name__}: {e}"
-        diag["trace_tail"] = traceback.format_exc().splitlines()[-3:]
-        _attach_last_good(diag)
-        _emit(diag)
+        # HBM OOM is an OPERATING-POINT problem, not a tunnel problem:
+        # rather than bank a 0.0, rerun once with backbone/FPN remat
+        # (the knob the optimized chart exposes as TRAIN.REMAT) and
+        # record that the headline needed it.  Observed round 3: the
+        # XLA ROIAlign backward's temps overflowed 15.75G HBM.
+        retried_ok = False
+        if _is_hbm_oom(e) and not args.remat:
+            print("bench: HBM OOM at this operating point; retrying "
+                  "with TRAIN.REMAT=True", file=sys.stderr)
+            # snapshot the failure, then DROP the traceback before the
+            # rerun: the failed attempt's params/opt_state/batch HBM
+            # buffers live in its frames, and holding them through the
+            # retry would shave hundreds of MB off a compile that is
+            # already within ~0.5G of capacity
+            err_msg = f"{type(e).__name__}: {e}"
+            traceback.clear_frames(e.__traceback__)
+            e = RuntimeError(err_msg)
+            args.remat = True
+            diag["remat_fallback"] = True
+            diag["pre_remat_error"] = err_msg.splitlines()[0][:200]
+            try:
+                run(args, diag)   # on success this emits the ONE line
+                retried_ok = True
+            except Exception as e2:  # noqa: BLE001
+                e = e2
+        if not retried_ok:
+            diag["error"] = f"{type(e).__name__}: {e}"
+            diag["trace_tail"] = "".join(
+                traceback.format_exception(type(e), e, e.__traceback__)
+            ).splitlines()[-3:]
+            _attach_last_good(diag)
+            _emit(diag)
     # a timed-out init attempt leaves a non-daemon worker thread stuck
     # inside jax.devices(); normal interpreter shutdown would join it
     # and hang forever — hard-exit once the JSON line is flushed

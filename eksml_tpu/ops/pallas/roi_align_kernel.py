@@ -48,6 +48,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import threading
 from typing import Sequence
 
 import jax
@@ -127,11 +128,37 @@ def _gate(env_var: str, dtype, cache: dict, probe) -> bool:
         # would be staged into the caller's jaxpr, so np.asarray(out)
         # raised TracerArrayConversionError, the blanket except caught
         # it, and every auto-mode run silently demoted to XLA on real
-        # hardware.  Escape the trace so the probe compiles and RUNS
-        # eagerly, exactly as it does outside jit.
-        with jax.ensure_compile_time_eval():
-            cache[key] = probe(dtype)
+        # hardware.  ``jax.ensure_compile_time_eval()`` (the round-3
+        # first fix) escapes the *outer* trace but corrupts
+        # ``pallas_call``'s INNER kernel trace: on real TPU the probe
+        # died with "Evaluation rule for 'program_id' not implemented"
+        # — program_id was evaluated eagerly instead of inside the
+        # kernel trace — so auto-mode still demoted to XLA on hardware.
+        # JAX trace state is thread-LOCAL: a fresh thread has a clean
+        # trace stack, so the probe there runs exactly as it would at
+        # top level, with no context-manager interplay at all.
+        cache[key] = _run_outside_any_trace(probe, dtype)
     return cache[key]
+
+
+def _run_outside_any_trace(probe, dtype) -> bool:
+    """Run ``probe(dtype)`` in a fresh thread (clean thread-local trace
+    stack) so a gate reached mid-jit-trace still compiles and executes
+    the probe kernel for real.  Probes swallow their own exceptions; a
+    thread-level failure (e.g. runtime teardown) counts as probe-fail."""
+    result = {"ok": False}
+
+    def _worker():
+        try:
+            result["ok"] = bool(probe(dtype))
+        except BaseException as e:  # noqa: BLE001 — never kill the host trace
+            log.warning("Pallas probe thread failed for %s: %s",
+                        np.dtype(dtype), e)
+
+    t = threading.Thread(target=_worker, name="pallas-probe", daemon=True)
+    t.start()
+    t.join()
+    return result["ok"]
 
 
 def pallas_roi_align_supported(dtype=jnp.float32) -> bool:

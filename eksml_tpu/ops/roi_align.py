@@ -22,10 +22,32 @@ offset), which is what modern Mask-RCNN implementations use.
 
 from __future__ import annotations
 
+import os
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+
+# The gather formulation materializes [N, out, s, out, s, C]
+# intermediates (and their transposes in the backward) — at the
+# optimized operating point (batch 4, 1344², 512 ROIs) that is 4×1.5 GB
+# of f32 HLO temps, which overflowed the v5e's 15.75 GB HBM on the
+# round-3 bench.  Processing ROIs in chunks through ``lax.map`` bounds
+# the temps to a chunk's share while XLA's scan-transpose accumulates
+# the feature gradient across chunks; outputs are bit-identical (each
+# ROI's computation is independent).  0 disables chunking.
+_ROI_CHUNK = int(os.environ.get("EKSML_ROI_CHUNK", "128"))
+
+
+def _chunk_size(n: int) -> int | None:
+    """Largest divisor of ``n`` that is ≤ the chunk bound (static shape
+    arithmetic — runs at trace time), or None when chunking is off or
+    pointless (n within bound, or n prime)."""
+    c = _ROI_CHUNK
+    if c <= 0 or n <= c:
+        return None
+    best = max(d for d in range(1, c + 1) if n % d == 0)
+    return best if best > 1 else None
 
 
 def _bilinear_gather(feat: jnp.ndarray, y: jnp.ndarray, x: jnp.ndarray):
@@ -139,6 +161,21 @@ def multilevel_roi_align(feats: Sequence[jnp.ndarray], rois: jnp.ndarray,
         levels = assign_fpn_levels(
             rois, min_level=min_level,
             max_level=min_level + len(feats) - 1) - min_level
+    n = rois.shape[0]
+    c = _chunk_size(n)
+    if c is not None:
+        feats = tuple(feats)
+        out = jax.lax.map(
+            lambda rl: _multilevel_impl(feats, rl[0], strides, out_size,
+                                        sampling_ratio, rl[1]),
+            (rois.reshape(n // c, c, 4), levels.reshape(n // c, c)))
+        return out.reshape(n, out_size, out_size, feats[0].shape[-1])
+    return _multilevel_impl(feats, rois, strides, out_size,
+                            sampling_ratio, levels)
+
+
+def _multilevel_impl(feats, rois, strides, out_size, sampling_ratio,
+                     levels):
     out = None
     for i, (feat, stride) in enumerate(zip(feats, strides)):
         mask = (levels == i).astype(feat.dtype)

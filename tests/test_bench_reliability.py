@@ -80,6 +80,59 @@ def test_main_emits_diagnostic_json_on_failure(monkeypatch, capsys):
     assert "boom" in diag["error"]
 
 
+def test_main_retries_hbm_oom_with_remat(monkeypatch, capsys):
+    """An XLA 'Ran out of memory in memory space hbm' compile failure
+    is an operating-point problem (round 3: the XLA ROIAlign backward's
+    temps overflowed the v5e's 15.75G) — bench must rerun once with
+    TRAIN.REMAT=True instead of banking a 0.0, and still emit exactly
+    ONE JSON line."""
+    import json
+
+    calls = []
+
+    def fake_run(args, diag):
+        calls.append(args.remat)
+        if not args.remat:
+            raise RuntimeError(
+                "XLA:TPU compile permanent error. Ran out of memory in "
+                "memory space hbm. Used 16.22G of 15.75G hbm.")
+        diag["value"] = 7.5
+        bench_mod._emit(diag)
+
+    monkeypatch.setattr(bench_mod, "run", fake_run)
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "1"])
+    out_lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.strip().startswith("{")]
+    assert calls == [False, True]
+    assert len(out_lines) == 1, out_lines
+    diag = json.loads(out_lines[0])
+    assert diag["value"] == 7.5
+    assert diag["remat_fallback"] is True
+    assert "error" not in diag
+
+
+def test_main_oom_retry_failure_reports_second_error(monkeypatch,
+                                                     capsys):
+    """If the remat rerun ALSO fails, the diagnostic line must carry
+    the second (post-remat) error, marked with remat_fallback."""
+    import json
+
+    def fake_run(args, diag):
+        if not args.remat:
+            raise RuntimeError("Ran out of memory in memory space hbm.")
+        raise RuntimeError("still too big even with remat")
+
+    monkeypatch.setattr(bench_mod, "run", fake_run)
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "1"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    diag = json.loads(line)
+    assert diag["value"] == 0.0
+    assert "still too big" in diag["error"]
+    assert diag["remat_fallback"] is True
+
+
 def test_collective_flag_rollback_on_rejection(monkeypatch):
     """A combine-threshold flag an old libtpu rejects must be rolled
     back out of LIBTPU_INIT_ARGS (one bad flag otherwise fails EVERY

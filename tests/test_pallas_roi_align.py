@@ -223,3 +223,45 @@ def test_gate_probe_survives_mid_trace(monkeypatch):
     assert probe_calls == [True]
     assert cache == {"float32": True}
     assert float(res) == 1.0
+
+
+def test_gate_probe_runs_pallas_call_mid_trace(monkeypatch):
+    """Round-3 hardware regression: ``jax.ensure_compile_time_eval()``
+    escapes the OUTER trace but corrupts ``pallas_call``'s inner kernel
+    trace — on the real TPU the auto-mode probe died with "Evaluation
+    rule for 'program_id' not implemented" and silently demoted the
+    bench to XLA.  The probe must therefore run where no ambient trace
+    exists at all (a fresh thread: JAX trace state is thread-local).
+    This probe runs an actual pallas_call whose kernel uses
+    pl.program_id — the exact op that broke — mid-jit-trace."""
+    from jax.experimental import pallas as pl
+
+    from eksml_tpu.ops.pallas import roi_align_kernel as rk
+
+    def kern(x_ref, o_ref):
+        i = pl.program_id(0)
+        o_ref[...] = x_ref[...] + jnp.float32(i)
+
+    def pallas_probe(dtype):
+        x = jnp.ones((2, 8, 128), dtype)
+        out = pl.pallas_call(
+            kern, grid=(2,),
+            in_specs=[pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((2, 8, 128), dtype),
+            interpret=True)(x)
+        return bool(np.isfinite(np.asarray(out, np.float32)).all())
+
+    monkeypatch.setattr(rk.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("EKSML_ROI_BACKEND", raising=False)
+    cache = {}
+
+    @jax.jit
+    def traced(x):
+        ok = rk._gate("EKSML_ROI_BACKEND", jnp.float32, cache,
+                      pallas_probe)
+        return x + (1.0 if ok else 0.0)
+
+    res = traced(jnp.zeros(()))
+    assert cache == {"float32": True}, cache
+    assert float(res) == 1.0

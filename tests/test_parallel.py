@@ -169,6 +169,34 @@ def test_slice_groups_hardware_attr():
     assert slice_groups([Dev(0, 0), Dev(1, 0)]) is None  # single slice
 
 
+def test_dryrun_device_selection_is_slice_aware():
+    """__graft_entry__.dryrun_multichip must never hand build_mesh a
+    subset that straddles slices unevenly (4+2 of a 2×4 deployment has
+    no valid mesh): single-slice subsets when n fits in one slice,
+    whole slices when n divides into them, a clear error otherwise,
+    and the synthetic 2-split only for sliceless (CPU) devices."""
+    from __graft_entry__ import _select_dryrun_devices
+
+    class Dev:
+        def __init__(self, i, s):
+            self.id, self.slice_index = i, s
+
+    hw = [Dev(i, i // 4) for i in range(8)]  # 2 slices × 4 chips
+
+    devs, ns = _select_dryrun_devices(hw, 3)       # fits slice 0
+    assert [d.id for d in devs] == [0, 1, 2] and ns == 1
+    devs, ns = _select_dryrun_devices(hw, 8)       # both whole slices
+    assert [d.id for d in devs] == list(range(8)) and ns == 1
+    with pytest.raises(ValueError, match="no valid multi-slice mesh"):
+        _select_dryrun_devices(hw, 6)              # 4+2 straddle
+
+    cpu = [object() for _ in range(8)]             # no slice_index
+    devs, ns = _select_dryrun_devices(cpu, 8)
+    assert len(devs) == 8 and ns == 2              # synthetic split
+    devs, ns = _select_dryrun_devices(cpu, 5)
+    assert len(devs) == 5 and ns == 1
+
+
 def test_multislice_hardware_groups_validation():
     """Hardware-path guards (stub devices carrying slice_index): the
     validation runs before Mesh construction, so error paths are

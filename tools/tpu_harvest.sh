@@ -14,7 +14,13 @@ WAIT_HEADLINE=${WAIT_HEADLINE:-1}
 say() { echo "[harvest] $(date -u +%H:%M:%S) $*" >> "$LOG"; }
 
 wait_for_bench_slot() {
-    while pgrep -f "python bench.py" >/dev/null 2>&1; do sleep 60; done
+    # one TPU client at a time: bench.py AND any TPU convergence run
+    # count as holding the slot.  A `--platform cpu` convergence hedge
+    # (run in parallel on the host) does NOT hold the TPU.
+    while pgrep -af "python bench.py|tools/convergence_run.py" \
+        2>/dev/null | grep -v "platform cpu" | grep -q .; do
+        sleep 60
+    done
 }
 
 run_bench() {  # run_bench <tag> <args...> -> writes artifacts/<tag>.json
@@ -70,5 +76,40 @@ if python tools/trace_summary.py profile \
     say "profile summary banked"
 else
     say "profile summary FAILED — see above; trace left in ./profile"
+fi
+
+# Convergence at real model scale ON HARDWARE (VERDICT r2 next #4):
+# the full R50-FPN run that takes most of a day on the 1-core CPU box
+# finishes in minutes on the chip.  Banked to a separate file first so
+# a half-written artifact can never clobber a good CPU-run one; only a
+# run that passes the tool's own convergence asserts is promoted.
+if [ ! -s artifacts/convergence_r3.json ]; then
+    wait_for_bench_slot
+    say "running TPU convergence (full R50-FPN, 512px)"
+    if python tools/convergence_run.py --steps 300 --size 512 \
+        --out artifacts/convergence_r3_tpu.json \
+        --config RPN.TRAIN_PRE_NMS_TOPK=512 RPN.TRAIN_POST_NMS_TOPK=128 \
+        RPN.TEST_PRE_NMS_TOPK=512 RPN.TEST_POST_NMS_TOPK=128 \
+        FRCNN.BATCH_PER_IM=128 >> "$LOG" 2>&1; then
+        # promote only a real-accelerator run: with the tunnel down jax
+        # silently falls back to CPU, and a CPU run must not be banked
+        # as the hardware convergence artifact (same device-kind gate
+        # the retry loop applies to the headline)
+        if python -c '
+import json, sys
+d = json.load(open("artifacts/convergence_r3_tpu.json"))
+sys.exit(0 if d.get("device", "").lower() not in ("", "cpu", "host")
+         else 1)'; then
+            cp artifacts/convergence_r3_tpu.json \
+               artifacts/convergence_r3.json
+            say "TPU convergence banked as convergence_r3.json"
+        else
+            say "convergence ran on CPU fallback — NOT promoted"
+        fi
+    else
+        say "TPU convergence FAILED (CPU hedge still authoritative)"
+    fi
+else
+    say "convergence_r3.json already banked; skipping TPU run"
 fi
 say "harvest complete"
