@@ -9,7 +9,7 @@ is a shape-constant `jnp.repeat` — cheap and fusible on TPU.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -24,12 +24,16 @@ def _upsample2x(x: jnp.ndarray) -> jnp.ndarray:
 
 class FPN(nn.Module):
     num_channels: int = 256
+    # compute dtype: without it flax promotes bf16 activations back to
+    # the f32 param dtype (see resnet.py Bottleneck.dtype)
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, feats: Sequence[jnp.ndarray]) -> Tuple[jnp.ndarray, ...]:
         """C2..C5 → (P2, P3, P4, P5, P6)."""
         laterals = [
-            nn.Conv(self.num_channels, (1, 1), name=f"lateral_{i+2}")(c)
+            nn.Conv(self.num_channels, (1, 1), dtype=self.dtype,
+                    name=f"lateral_{i+2}")(c)
             for i, c in enumerate(feats)
         ]
         # top-down pathway
@@ -38,7 +42,8 @@ class FPN(nn.Module):
             merged.append(lat + _upsample2x(merged[-1]))
         merged = merged[::-1]  # P2..P5 order
         outs = [
-            nn.Conv(self.num_channels, (3, 3), name=f"posthoc_{i+2}")(m)
+            nn.Conv(self.num_channels, (3, 3), dtype=self.dtype,
+                    name=f"posthoc_{i+2}")(m)
             for i, m in enumerate(merged)
         ]
         p6 = nn.max_pool(outs[-1], (1, 1), strides=(2, 2))

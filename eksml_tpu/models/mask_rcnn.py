@@ -129,8 +129,10 @@ class MaskRCNN(nn.Module):
         self.backbone = bb_cls(num_blocks=self.resnet_blocks,
                                norm=self.norm,
                                freeze_at=self.freeze_at,
+                               dtype=self.compute_dtype,
                                name="backbone")
-        self.fpn = fpn_cls(num_channels=self.fpn_channels, name="fpn")
+        self.fpn = fpn_cls(num_channels=self.fpn_channels,
+                           dtype=self.compute_dtype, name="fpn")
         self.rpn_head = RPNHead(num_anchors=len(self.anchor_ratios),
                                 channels=self.fpn_channels,
                                 dtype=self.compute_dtype, name="rpn")

@@ -16,7 +16,7 @@ maskrcnn-optimized/templates/maskrcnn.yaml:47-48).
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -46,11 +46,14 @@ class FrozenBN(nn.Module):
         return x * inv.astype(x.dtype) + shift.astype(x.dtype)
 
 
-def _norm(norm: str):
+def _norm(norm: str, dtype=jnp.float32):
     if norm == "FreezeBN":
-        return FrozenBN()
+        return FrozenBN()  # folds to a mul-add in the input's dtype
     if norm == "GN":
-        return nn.GroupNorm(num_groups=32, dtype=jnp.float32)
+        # compute dtype follows the policy (params stay f32 via
+        # param_dtype default); pinning dtype=f32 here re-promoted
+        # every inter-block activation under the bf16 policy
+        return nn.GroupNorm(num_groups=32, dtype=dtype)
     raise ValueError(norm)
 
 
@@ -58,25 +61,32 @@ class Bottleneck(nn.Module):
     channels: int
     stride: int = 1
     norm: str = "FreezeBN"
+    # compute dtype for the convs.  Without an explicit dtype flax
+    # PROMOTES bf16 activations back to the f32 param dtype, silently
+    # running the whole backbone — ~80% of model FLOPs — in f32 (found
+    # via the round-3 HBM dump: f32 conv temps under a bf16 policy).
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        out = nn.Conv(self.channels, (1, 1), use_bias=False, name="conv1")(x)
-        out = _norm(self.norm)(out)
+        out = nn.Conv(self.channels, (1, 1), use_bias=False,
+                      dtype=self.dtype, name="conv1")(x)
+        out = _norm(self.norm, self.dtype)(out)
         out = nn.relu(out)
         out = nn.Conv(self.channels, (3, 3), strides=(self.stride, self.stride),
-                      use_bias=False, name="conv2")(out)
-        out = _norm(self.norm)(out)
+                      use_bias=False, dtype=self.dtype, name="conv2")(out)
+        out = _norm(self.norm, self.dtype)(out)
         out = nn.relu(out)
         out = nn.Conv(self.channels * 4, (1, 1), use_bias=False,
-                      name="conv3")(out)
-        out = _norm(self.norm)(out)
+                      dtype=self.dtype, name="conv3")(out)
+        out = _norm(self.norm, self.dtype)(out)
         if residual.shape != out.shape:
             residual = nn.Conv(self.channels * 4, (1, 1),
                                strides=(self.stride, self.stride),
-                               use_bias=False, name="convshortcut")(x)
-            residual = _norm(self.norm)(residual)
+                               use_bias=False, dtype=self.dtype,
+                               name="convshortcut")(x)
+            residual = _norm(self.norm, self.dtype)(residual)
         return nn.relu(out + residual)
 
 
@@ -89,13 +99,14 @@ class ResNetBackbone(nn.Module):
     num_blocks: Sequence[int] = (3, 4, 6, 3)
     norm: str = "FreezeBN"
     freeze_at: int = 2  # freeze conv1+res2, TensorPack default
+    dtype: Any = jnp.float32  # compute dtype (params stay f32)
 
     @nn.compact
     def __call__(self, x) -> Tuple[jnp.ndarray, ...]:
         # stem: 7x7/2 conv + 3x3/2 maxpool → stride 4
         x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False,
-                    name="conv0")(x)
-        x = _norm(self.norm)(x)
+                    dtype=self.dtype, name="conv0")(x)
+        x = _norm(self.norm, self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
@@ -105,7 +116,7 @@ class ResNetBackbone(nn.Module):
             stride = 1 if stage == 0 else 2
             for b in range(blocks):
                 x = Bottleneck(ch, stride=stride if b == 0 else 1,
-                               norm=self.norm,
+                               norm=self.norm, dtype=self.dtype,
                                name=f"group{stage}_block{b}")(x)
             # FREEZE_AT=2 freezes stem+res2 (stage 0) — implemented as a
             # gradient stop, which under SGD(+wd on trainables only)
