@@ -63,6 +63,14 @@ class MaskHead(nn.Module):
                        dtype=self.dtype)(x).astype(jnp.float32)
 
 
+def max_fg_proposals(batch_per_im: int, fg_ratio: float) -> int:
+    """Static cap on fg proposals per image — THE shared definition:
+    the sampler compacts taken-fg into this many leading slots, and the
+    mask head slices exactly this prefix (mask_rcnn.py).  A drifted
+    re-derivation would silently slice fg ROIs out of the mask loss."""
+    return max(1, int(batch_per_im * fg_ratio))
+
+
 def sample_proposal_targets(
     proposals: jnp.ndarray,       # [P, 4]
     proposal_scores: jnp.ndarray, # [P] (-inf padding)
@@ -99,7 +107,7 @@ def sample_proposal_targets(
     fg_cand = (best_iou >= fg_thresh) & pool_valid
     bg_cand = (best_iou < fg_thresh) & pool_valid & (crowd_iou < fg_thresh)
 
-    max_fg = int(batch_per_im * fg_ratio)
+    max_fg = max_fg_proposals(batch_per_im, fg_ratio)
     rng_fg, rng_bg = jax.random.split(rng)
     fg_idx, fg_take = sample_by_priority(fg_cand, rng_fg, max_fg)
     num_bg = batch_per_im - fg_take.sum()

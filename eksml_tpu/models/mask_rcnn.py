@@ -285,15 +285,27 @@ class MaskRCNN(nn.Module):
         if self.with_masks and "gt_masks" in batch:
             mr = self.mask_resolution
             ma = mr // 2  # deconv in the head doubles resolution
+            # Only fg ROIs carry mask loss, and the sampler compacts
+            # taken-fg into the FIRST max_fg slots
+            # (sample_proposal_targets: argsort(~take) is stable with
+            # the fg block leading) — so a static prefix slice covers
+            # every fg ROI.  At fg_ratio=0.25 this cuts the mask
+            # ROIAlign gathers, head convs, and the [B·S,28,28,K]
+            # logits HBM by 4× with a bit-identical loss (TensorPack's
+            # mask head likewise runs on fg proposals only).
+            from eksml_tpu.models.heads import max_fg_proposals
+            k = max_fg_proposals(s, self.frcnn_fg_ratio)
+            rois_m = rois[:, :k]
             mask_feats = dispatch_roi_align(
-                feats[:4], rois, self.anchor_strides[:4], ma)
+                feats[:4], rois_m, self.anchor_strides[:4], ma)
             mask_logits = self.mask_head(
-                mask_feats.reshape(b * s, ma, ma, -1))
-            mask_logits = mask_logits.reshape(b, s, mr, mr, -1)
+                mask_feats.reshape(b * k, ma, ma, -1))
+            mask_logits = mask_logits.reshape(b, k, mr, mr, -1)
             targets = jax.vmap(self._mask_targets)(
-                rois, matched_gt, batch["gt_boxes"], batch["gt_masks"])
+                rois_m, matched_gt[:, :k], batch["gt_boxes"],
+                batch["gt_masks"])
             mask_loss = jax.vmap(mask_head_loss)(
-                mask_logits, roi_labels, targets, fg_mask)
+                mask_logits, roi_labels[:, :k], targets, fg_mask[:, :k])
             losses["mrcnn_loss"] = mask_loss.mean()
 
         losses["total_loss"] = sum(losses.values())

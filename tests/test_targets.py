@@ -83,3 +83,32 @@ def test_sample_proposal_targets_static_shapes():
     lab = np.asarray(labels)
     assert (lab[np.asarray(fg)] == 3).all()
     assert (lab[~np.asarray(fg)] == 0).all()
+
+
+def test_fg_proposals_occupy_leading_slots():
+    """The mask head slices the FIRST int(S·fg_ratio) slots instead of
+    running on all S sampled ROIs (mask_rcnn.py mask-head section) —
+    valid only because the sampler compacts taken-fg entries to the
+    front.  Pin that invariant: every fg slot index < max_fg, and the
+    fg region is a prefix of the taken-fg count, across seeds."""
+    p = 64
+    rng = np.random.RandomState(7)
+    for seed in range(5):
+        props = jnp.asarray(rng.rand(p, 4) * 60 +
+                            np.array([0, 0, 20, 20]), jnp.float32)
+        scores = jnp.where(jnp.arange(p) < 50, 0.5, -jnp.inf)
+        gt = jnp.asarray([[10, 10, 40, 40], [30, 30, 70, 70]],
+                         jnp.float32)
+        gt_cls = jnp.asarray([3, 5])
+        gt_valid = jnp.asarray([1.0, 1.0])
+        _, _, _, fg, _ = sample_proposal_targets(
+            props, scores, gt, gt_cls, gt_valid,
+            jax.random.PRNGKey(seed), batch_per_im=16,
+            fg_thresh=0.5, fg_ratio=0.25)
+        from eksml_tpu.models.heads import max_fg_proposals
+        fg = np.asarray(fg)
+        max_fg = max_fg_proposals(16, 0.25)
+        n_fg = int(fg.sum())
+        assert fg[:n_fg].all(), fg          # fg is a contiguous prefix
+        assert not fg[n_fg:].any(), fg
+        assert n_fg <= max_fg
