@@ -67,8 +67,11 @@ def max_fg_proposals(batch_per_im: int, fg_ratio: float) -> int:
     """Static cap on fg proposals per image — THE shared definition:
     the sampler compacts taken-fg into this many leading slots, and the
     mask head slices exactly this prefix (mask_rcnn.py).  A drifted
-    re-derivation would silently slice fg ROIs out of the mask loss."""
-    return max(1, int(batch_per_im * fg_ratio))
+    re-derivation would silently slice fg ROIs out of the mask loss.
+    No floor here: fg_ratio=0 legitimately means a pure-background
+    head batch; the mask-head SLICE applies its own ≥1 floor because
+    a zero-length static slice cannot exist."""
+    return int(batch_per_im * fg_ratio)
 
 
 def sample_proposal_targets(

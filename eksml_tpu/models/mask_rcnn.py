@@ -28,7 +28,8 @@ import numpy as np
 
 from eksml_tpu.models.fpn import FPN
 from eksml_tpu.models.heads import (BoxHead, MaskHead, box_head_losses,
-                                    mask_head_loss, sample_proposal_targets)
+                                    mask_head_loss, max_fg_proposals,
+                                    sample_proposal_targets)
 from eksml_tpu.models.resnet import ResNetBackbone
 from eksml_tpu.models.rpn import (RPNHead, generate_proposals, match_anchors,
                                   rpn_losses, sample_anchors)
@@ -293,8 +294,7 @@ class MaskRCNN(nn.Module):
             # ROIAlign gathers, head convs, and the [B·S,28,28,K]
             # logits HBM by 4× with a bit-identical loss (TensorPack's
             # mask head likewise runs on fg proposals only).
-            from eksml_tpu.models.heads import max_fg_proposals
-            k = max_fg_proposals(s, self.frcnn_fg_ratio)
+            k = max(1, max_fg_proposals(s, self.frcnn_fg_ratio))
             rois_m = rois[:, :k]
             mask_feats = dispatch_roi_align(
                 feats[:4], rois_m, self.anchor_strides[:4], ma)

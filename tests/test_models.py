@@ -143,6 +143,27 @@ def test_predict_shapes_and_validity():
     assert bx.min() >= 0 and bx.max() <= 128
 
 
+@pytest.mark.slow
+def test_remat_bf16_train_grads_compile():
+    """TRAIN.REMAT is the bench's HBM-OOM escape hatch (bench.py reruns
+    an OOM'd operating point with remat on), so the nn.remat-wrapped
+    backbone/FPN must actually compile and differentiate — including
+    under the bf16 policy threaded through their dtype attrs."""
+    m = tiny_model(remat=True, compute_dtype=jnp.bfloat16)
+    batch = tiny_batch()
+    rng = jax.random.PRNGKey(0)
+    params = m.init(rng, batch, rng)["params"]
+
+    def loss_fn(p):
+        return m.apply({"params": p}, batch, rng)["total_loss"]
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    assert np.isfinite(float(loss))
+    gn = sum(float((np.asarray(g, np.float32) ** 2).sum())
+             for g in jax.tree.leaves(grads))
+    assert np.isfinite(gn) and gn > 0
+
+
 @pytest.mark.parametrize("norm", ["FreezeBN", "GN"])
 def test_bf16_policy_reaches_backbone_and_fpn(fresh_config, norm):
     """Round-3 perf regression: backbone/FPN convs carried no explicit

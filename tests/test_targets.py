@@ -5,7 +5,8 @@ import jax
 import jax.numpy as jnp
 
 from eksml_tpu.models.rpn import match_anchors, sample_anchors
-from eksml_tpu.models.heads import sample_proposal_targets
+from eksml_tpu.models.heads import (max_fg_proposals,
+                                    sample_proposal_targets)
 from eksml_tpu.ops.sampling import sample_by_priority, sample_mask_by_priority
 
 
@@ -105,7 +106,6 @@ def test_fg_proposals_occupy_leading_slots():
             props, scores, gt, gt_cls, gt_valid,
             jax.random.PRNGKey(seed), batch_per_im=16,
             fg_thresh=0.5, fg_ratio=0.25)
-        from eksml_tpu.models.heads import max_fg_proposals
         fg = np.asarray(fg)
         max_fg = max_fg_proposals(16, 0.25)
         n_fg = int(fg.sum())
