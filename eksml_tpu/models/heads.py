@@ -68,10 +68,13 @@ def max_fg_proposals(batch_per_im: int, fg_ratio: float) -> int:
     the sampler compacts taken-fg into this many leading slots, and the
     mask head slices exactly this prefix (mask_rcnn.py).  A drifted
     re-derivation would silently slice fg ROIs out of the mask loss.
-    No floor here: fg_ratio=0 legitimately means a pure-background
-    head batch; the mask-head SLICE applies its own ≥1 floor because
-    a zero-length static slice cannot exist."""
-    return int(batch_per_im * fg_ratio)
+    fg_ratio=0 legitimately means a pure-background head batch (0);
+    any positive ratio keeps at least one fg slot even when the
+    product floors below 1 (tiny smoke configs).  The mask-head SLICE
+    additionally applies its own ≥1 floor because a zero-length static
+    slice cannot exist."""
+    n = int(batch_per_im * fg_ratio)
+    return max(1, n) if fg_ratio > 0 else 0
 
 
 def sample_proposal_targets(

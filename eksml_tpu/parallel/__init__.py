@@ -16,4 +16,5 @@ from eksml_tpu.parallel.mesh import (  # noqa: F401
 from eksml_tpu.parallel.distributed import (  # noqa: F401
     initialize_from_env, process_count, process_index)
 from eksml_tpu.parallel.collectives import (  # noqa: F401
-    cross_host_sum, param_fingerprint, set_xla_collective_flags)
+    cross_host_sum, param_fingerprint, set_xla_collective_flags,
+    warm_mesh_collectives)

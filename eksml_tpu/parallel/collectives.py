@@ -59,6 +59,44 @@ def set_xla_collective_flags(combine_threshold_bytes: int,
             "with XLA's default collective fusion (%s)", e)
 
 
+def warm_mesh_collectives(mesh: Mesh) -> None:
+    """Establish THIS mesh's cross-host collective context with one
+    trivial all-reduce, executed at init while every host is aligned
+    from the rendezvous barrier.
+
+    Collective channels connect lazily at the first executed collective
+    with a fixed deadline (XLA:CPU's Gloo pairs: ~30 s).  In training,
+    that first execution sits right after each host's train-step
+    compile — and any compile-time skew (cache hit on one host, miss on
+    another; a loaded CI box) lands inside the connect window and kills
+    the run with "Gloo context initialization failed".  Horovod solved
+    the same problem with its init-time allreduce; this is that, per
+    mesh.  No-op single-process.  One retry absorbs a transient
+    first-connect timeout; a second failure raises — failing fast at
+    init beats failing minutes later at step 1."""
+    if jax.process_count() == 1:
+        return
+    from jax.sharding import NamedSharding
+
+    n = int(np.prod(mesh.devices.shape))
+    x = jax.device_put(
+        jnp.ones((n,), jnp.float32),
+        NamedSharding(mesh, P(tuple(mesh.axis_names))))
+    total = jax.jit(jnp.sum,
+                    out_shardings=NamedSharding(mesh, P()))
+    for attempt in (1, 2):
+        try:
+            out = float(np.asarray(total(x)))
+            if out != float(n):  # explicit: must survive python -O
+                raise AssertionError(
+                    f"mesh warm-up all-reduce returned {out}, "
+                    f"expected {n} — collective context is broken")
+            return
+        except Exception:  # noqa: BLE001 — one retry, then surface
+            if attempt == 2:
+                raise
+
+
 def cross_host_sum(tree):
     """Sum a pytree of *host-local* metric values across all processes
     (loss sums, eval detection counts) — the role Horovod's allreduce

@@ -42,7 +42,7 @@ from eksml_tpu.config import config_from_env, finalize_configs
 from eksml_tpu.models import MaskRCNN
 from eksml_tpu.parallel import (batch_sharding, build_mesh,
                                 initialize_from_env, replicated_sharding,
-                                validate_topology)
+                                validate_topology, warm_mesh_collectives)
 from eksml_tpu.parallel.collectives import set_xla_collective_flags
 from eksml_tpu.utils import CheckpointManager, MetricWriter
 
@@ -160,6 +160,11 @@ class Trainer:
         self.mesh = build_mesh(tuple(cfg.TPU.MESH_SHAPE),
                                tuple(cfg.TPU.MESH_AXES),
                                num_slices=cfg.TPU.NUM_SLICES)
+        # Horovod-style init allreduce: connect this mesh's collective
+        # channels NOW, while all hosts are barrier-aligned — the lazy
+        # first-collective connect otherwise races per-host compile
+        # skew against a fixed deadline (collectives.py)
+        warm_mesh_collectives(self.mesh)
         self.model = MaskRCNN.from_config(cfg)
         self.tx, self.sched = make_optimizer(cfg)
         # write_metrics=False gives read-only consumers (eval_ckpt) a

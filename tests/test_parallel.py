@@ -221,3 +221,29 @@ def test_multislice_hardware_groups_validation():
     # num_slices contradicting the hardware count
     with pytest.raises(ValueError, match="contradicts hardware"):
         build_mesh(mesh_shape=(4, 1), devices=even, num_slices=3)
+
+
+def test_warm_mesh_collectives_runs_mesh_allreduce(monkeypatch):
+    """The init-time channel warm-up (Horovod-style first allreduce,
+    added after the multihost e2e flaked on Gloo's 30s lazy-connect
+    window) must execute a real all-reduce over the SAME mesh the
+    trainer uses — a different communicator (process_allgather) does
+    not establish the training clique.  Single-process it is a no-op;
+    force the multi-process branch and check the sharded sum."""
+    from eksml_tpu.parallel import build_mesh, collectives
+
+    calls = []
+    mesh = build_mesh((8, 1), ("data", "model"))
+
+    # no-op when single-process: device_put must never run
+    monkeypatch.setattr(collectives.jax, "device_put",
+                        lambda *a, **k: calls.append(1))
+    collectives.warm_mesh_collectives(mesh)
+    assert calls == []
+    monkeypatch.undo()
+
+    # multi-process branch: the all-reduce runs on this mesh and the
+    # result equals the device count (executed here on 8 local CPU
+    # devices — same program, local transport)
+    monkeypatch.setattr(collectives.jax, "process_count", lambda: 2)
+    collectives.warm_mesh_collectives(mesh)  # raises on failure
