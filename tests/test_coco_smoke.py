@@ -63,17 +63,10 @@ def test_train_main_on_disk_coco(mini_coco, tmp_path, fresh_config):
     from eksml_tpu import train as train_mod
 
     logdir = str(tmp_path / "run")
-    train_mod.main([
-        "--logdir", logdir,
-        "--total-steps", "2",
-        "--config",
-        f"DATA.BASEDIR={mini_coco}",
+    # ONE model-shape list shared by training and the offline eval —
+    # the Orbax restore requires architecture identity between the two
+    tiny_model = [
         "DATA.NUM_CLASSES=3",          # BG + person + dog
-        "TRAIN.STEPS_PER_EPOCH=2",     # eval + ckpt fire at step 2
-        "TRAIN.MAX_EPOCHS=1",
-        "TRAIN.LOG_PERIOD=1",
-        "TRAIN.EVAL_PERIOD=1",
-        "TRAIN.CHECKPOINT_PERIOD=1",
         "BACKBONE.WEIGHTS=",
         "PREPROC.MAX_SIZE=128",
         "PREPROC.TRAIN_SHORT_EDGE_SIZE=(128,128)",
@@ -86,6 +79,18 @@ def test_train_main_on_disk_coco(mini_coco, tmp_path, fresh_config):
         "BACKBONE.RESNET_NUM_BLOCKS=(1,1,1,1)",
         "TEST.RESULTS_PER_IM=8",
         "TPU.MESH_SHAPE=(1,1)",
+    ]
+    train_mod.main([
+        "--logdir", logdir,
+        "--total-steps", "2",
+        "--config",
+        f"DATA.BASEDIR={mini_coco}",
+        "TRAIN.STEPS_PER_EPOCH=2",     # eval + ckpt fire at step 2
+        "TRAIN.MAX_EPOCHS=1",
+        "TRAIN.LOG_PERIOD=1",
+        "TRAIN.EVAL_PERIOD=1",
+        "TRAIN.CHECKPOINT_PERIOD=1",
+        *tiny_model,
     ])
 
     # metrics written, eval ran, checkpoint saved
@@ -96,4 +101,25 @@ def test_train_main_on_disk_coco(mini_coco, tmp_path, fresh_config):
         "periodic COCO eval did not run/record")
     from eksml_tpu.utils import CheckpointManager
 
+    assert CheckpointManager(logdir).latest_step() == 2
+
+    # --- offline checkpoint eval (tools/eval_ckpt.py, the notebook's
+    # CLI twin): restore the checkpoint this run just wrote and rerun
+    # the evaluator read-only.  Same tiny config → compile-cache hit.
+    from tools import eval_ckpt
+
+    out_json = str(tmp_path / "offline_eval.json")
+    rc = eval_ckpt.main([
+        "--logdir", logdir, "--data", mini_coco, "--out", out_json,
+        "--config", *tiny_model,
+    ])
+    assert rc == 0, "eval_ckpt reported failure (see stderr)"
+    with open(out_json) as f:
+        offline = json.load(f)
+    assert offline["step"] == 2
+    assert "bbox/AP" in offline, offline
+    # read-only contract: the offline eval must not have appended to
+    # the training run's metrics or advanced its checkpoints
+    with open(os.path.join(logdir, "metrics.jsonl")) as f:
+        assert len([json.loads(l) for l in f]) == len(recs)
     assert CheckpointManager(logdir).latest_step() == 2
