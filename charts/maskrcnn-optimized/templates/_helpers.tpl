@@ -27,3 +27,21 @@ training pod Pending on a real nodepool.
 {{- $label := get $grids .Values.maskrcnn.topology -}}
 {{- required (printf "unknown topology %q (valid: %s)" .Values.maskrcnn.topology (keys $grids | sortAlpha | join ", ")) $label -}}
 {{- end -}}
+
+{{/*
+Hosts per slice: the JobSet renders num_slices replicated Jobs (one
+per v5e slice, DCN between them); each Job runs this many host pods.
+chips stays the TOTAL across slices, so hosts must divide evenly.
+*/}}
+{{- define "maskrcnn.hostsPerSlice" -}}
+{{- $hosts := include "maskrcnn.hosts" . | int -}}
+{{- $slices := int (.Values.maskrcnn.num_slices | default 1) -}}
+{{- $sliceChips := trimPrefix "v5e-" .Values.maskrcnn.topology | int -}}
+{{- if ne (int .Values.maskrcnn.chips) (mul $sliceChips $slices) -}}
+{{- fail (printf "chips (%d) must equal topology chips (%d) x num_slices (%d) — chips is the TOTAL across slices" (int .Values.maskrcnn.chips) $sliceChips $slices) -}}
+{{- end -}}
+{{- if ne (mod $hosts $slices) 0 -}}
+{{- fail (printf "hosts (%d) must divide evenly into num_slices (%d)" $hosts $slices) -}}
+{{- end -}}
+{{- div $hosts $slices -}}
+{{- end -}}

@@ -406,9 +406,14 @@ def config_from_env(cfg: AttrDict = None) -> AttrDict:
         "COORDINATOR_ADDRESS", cfg.TPU.COORDINATOR_ADDRESS)
     cfg.TPU.NUM_PROCESSES = int(os.environ.get(
         "NUM_PROCESSES", cfg.TPU.NUM_PROCESSES))
-    cfg.TPU.PROCESS_ID = int(os.environ.get(
-        "PROCESS_ID", os.environ.get("JOB_COMPLETION_INDEX",
-                                     cfg.TPU.PROCESS_ID)))
+    if any(k in os.environ for k in ("PROCESS_ID", "SLICE_INDEX",
+                                     "JOB_COMPLETION_INDEX")):
+        # ONE rank definition for both chart forms: single-slice
+        # PROCESS_ID, or the Multislice SLICE_INDEX·PROCS_PER_SLICE +
+        # JOB_COMPLETION_INDEX composition (parallel/distributed.py)
+        from eksml_tpu.parallel.distributed import _rank_from_env
+
+        cfg.TPU.PROCESS_ID = _rank_from_env(os.environ)
     cfg.freeze()
     return cfg
 

@@ -25,10 +25,13 @@ def initialize_from_env(cfg=None) -> None:
     """Call ``jax.distributed.initialize`` when the JobSet env says this
     is a multi-process run; no-op (idempotent) otherwise.
 
-    Env contract (rendered by charts/maskrcnn/templates/jobset.yaml):
+    Env contract (rendered by charts/maskrcnn/templates/maskrcnn.yaml):
       COORDINATOR_ADDRESS  host:port of replica 0
-      NUM_PROCESSES        total host processes
-      PROCESS_ID           this pod's index (JOB_COMPLETION_INDEX)
+      NUM_PROCESSES        total host processes (across ALL slices)
+      PROCESS_ID           this pod's global index (single-slice)
+      SLICE_INDEX +        Multislice form: the chart renders one
+      PROCS_PER_SLICE +      replicated Job per slice, so the global
+      JOB_COMPLETION_INDEX   rank is composed here instead
     """
     global _initialized
     if _initialized:
@@ -40,8 +43,7 @@ def initialize_from_env(cfg=None) -> None:
     else:
         coord = os.environ.get("COORDINATOR_ADDRESS", "")
         nproc = int(os.environ.get("NUM_PROCESSES", "1"))
-        pid = int(os.environ.get(
-            "PROCESS_ID", os.environ.get("JOB_COMPLETION_INDEX", "0")))
+        pid = _rank_from_env(os.environ)
     if nproc <= 1 or not coord:
         log.info("single-process run (NUM_PROCESSES=%s)", nproc)
         return
@@ -50,6 +52,22 @@ def initialize_from_env(cfg=None) -> None:
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=nproc, process_id=pid)
     _initialized = True
+
+
+def _rank_from_env(env) -> int:
+    """Global process rank from the JobSet env.
+
+    Single-slice: ``PROCESS_ID`` (the completion index) is the rank.
+    Multislice: each slice is its own replicated Job, so pods carry a
+    per-slice completion index plus the Job's slice index — the global
+    rank is ``SLICE_INDEX · PROCS_PER_SLICE + JOB_COMPLETION_INDEX``
+    (slice-major, matching build_mesh's slice-major device order)."""
+    if "PROCESS_ID" in env:
+        return int(env["PROCESS_ID"])
+    if "SLICE_INDEX" in env and "PROCS_PER_SLICE" in env:
+        return (int(env["SLICE_INDEX"]) * int(env["PROCS_PER_SLICE"])
+                + int(env.get("JOB_COMPLETION_INDEX", "0")))
+    return int(env.get("JOB_COMPLETION_INDEX", "0"))
 
 
 def process_count() -> int:

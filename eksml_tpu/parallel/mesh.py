@@ -68,22 +68,30 @@ def topology_label(topology: str) -> str:
 
 
 def validate_topology(topology: str = "", num_chips: Optional[int] = None,
-                      chips_per_host: int = 4) -> Tuple[int, int]:
+                      chips_per_host: int = 4,
+                      num_slices: int = 1) -> Tuple[int, int]:
     """Validate a requested slice the way the MPIJob CRD schema
     validated ``gpus`` — fail before any pod/job is created.
 
-    Returns ``(num_chips, num_hosts)``.
+    Multislice (``num_slices > 1``): ``topology`` names EACH slice and
+    ``num_chips`` is the TOTAL across slices (the chart's values
+    semantics), so the expected total is ``slice_chips · num_slices``.
+
+    Returns ``(num_chips, num_hosts)`` — totals across all slices.
     """
+    if num_slices < 1:
+        raise ValueError(f"num_slices={num_slices} must be >= 1")
     if topology:
         if topology not in V5E_TOPOLOGIES:
             raise ValueError(
                 f"unknown TPU topology {topology!r}; valid: "
                 f"{sorted(V5E_TOPOLOGIES)}")
         chips, hosts = V5E_TOPOLOGIES[topology]
+        chips, hosts = chips * num_slices, hosts * num_slices
         if num_chips not in (None, chips):
             raise ValueError(
-                f"TRAIN.NUM_CHIPS={num_chips} contradicts {topology} "
-                f"({chips} chips)")
+                f"TRAIN.NUM_CHIPS={num_chips} contradicts "
+                f"{num_slices}x{topology} ({chips} chips total)")
         return chips, hosts
     if num_chips is None:
         num_chips = len(jax.devices())

@@ -156,7 +156,8 @@ class Trainer:
         validate_topology(cfg.TPU.TOPOLOGY or "",
                           num_chips=(cfg.TRAIN.NUM_CHIPS
                                      if cfg.TRAIN.NUM_CHIPS > 1 else None),
-                          chips_per_host=cfg.TRAIN.CHIPS_PER_HOST)
+                          chips_per_host=cfg.TRAIN.CHIPS_PER_HOST,
+                          num_slices=cfg.TPU.NUM_SLICES)
         self.mesh = build_mesh(tuple(cfg.TPU.MESH_SHAPE),
                                tuple(cfg.TPU.MESH_AXES),
                                num_slices=cfg.TPU.NUM_SLICES)

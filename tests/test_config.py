@@ -69,3 +69,22 @@ def test_roundtrip_dict(fresh_config):
     clone = fresh_config.clone()
     clone.RPN.BATCH_PER_IM = 512
     assert fresh_config.RPN.BATCH_PER_IM == 256
+
+
+def test_config_from_env_multislice_rank(fresh_config, monkeypatch):
+    """config_from_env (the optimized-image entry) must compose the
+    SAME global rank the chart's Multislice env describes — the cfg
+    branch of initialize_from_env reads cfg.TPU.PROCESS_ID, so a
+    per-slice completion index left there would collide ranks across
+    slices at rendezvous."""
+    from eksml_tpu.config import config_from_env
+
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "host-0-0:8476")
+    monkeypatch.setenv("NUM_PROCESSES", "8")
+    monkeypatch.setenv("SLICE_INDEX", "1")
+    monkeypatch.setenv("PROCS_PER_SLICE", "4")
+    monkeypatch.setenv("JOB_COMPLETION_INDEX", "2")
+    monkeypatch.delenv("PROCESS_ID", raising=False)
+    cfg = config_from_env(fresh_config)
+    assert cfg.TPU.PROCESS_ID == 1 * 4 + 2
+    assert cfg.TPU.NUM_PROCESSES == 8

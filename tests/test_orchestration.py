@@ -153,11 +153,21 @@ def test_schema_topology_enum_matches_runtime_inventory():
     m = schema["properties"]["maskrcnn"]
     topo_enum = set(m["properties"]["topology"]["enum"])
     assert topo_enum == set(V5E_TOPOLOGIES)
-    chips_enum = set(m["properties"]["chips"]["enum"])
-    assert chips_enum == {c for c, _ in V5E_TOPOLOGIES.values()}
-    # every topology has an if/then pinning chips (and hosts coherence)
+    # chips is a free positive integer at the property level (the
+    # multislice TOTAL can be any product); exactness comes from the
+    # single-slice if/then pins plus the render-time product check in
+    # maskrcnn.hostsPerSlice and runtime validate_topology
+    chips_prop = m["properties"]["chips"]
+    assert chips_prop == {"type": "integer", "minimum": 1}
+    # every topology has an if/then pinning chips (and hosts
+    # coherence), scoped to the single-slice case — with num_slices>1
+    # chips is the TOTAL across slices and the runtime validator
+    # (validate_topology(num_slices=N)) owns the product check
     pinned = {}
     for clause in m["allOf"]:
+        if "topology" not in clause["if"]["properties"]:
+            continue  # the generic multislice sanity rule
+        assert clause["if"]["properties"]["num_slices"] == {"const": 1}
         topo = clause["if"]["properties"]["topology"]["const"]
         then = clause["then"]["properties"]
         pinned[topo] = (then["chips"]["const"],
@@ -358,3 +368,58 @@ def test_gcs_storage_variant():
         tmpl = _read(f"{chart}/templates/maskrcnn.yaml")
         assert 'eq .Values.maskrcnn.data_fs "gcs"' in tmpl, chart
         assert 'gke-gcsfuse/volumes: "true"' in tmpl, chart
+
+
+# ---- Multislice (num_slices) plumbing --------------------------------
+
+
+@pytest.mark.parametrize("chart", ["charts/maskrcnn",
+                                   "charts/maskrcnn-optimized"])
+def test_multislice_chart_plumbing(chart):
+    """num_slices > 1 = GKE Multislice: one replicated Job per slice
+    (exclusive-topology pins each Job to its own slice nodepool),
+    per-slice parallelism, slice-composed global rank env, and
+    TPU.NUM_SLICES handed to the trainer (parallel/mesh.py build_mesh).
+    chips stays the TOTAL across slices; topology names EACH slice."""
+    vals = yaml.safe_load(_read(f"{chart}/values.yaml"))
+    assert vals["maskrcnn"]["num_slices"] == 1  # single-slice default
+
+    schema = json.loads(_read(f"{chart}/values.schema.json"))
+    ns = schema["properties"]["maskrcnn"]["properties"]["num_slices"]
+    assert ns["type"] == "integer" and ns["minimum"] == 1
+
+    tpl = _read(f"{chart}/templates/maskrcnn.yaml")
+    assert "replicas: {{ $slices }}" in tpl
+    assert "parallelism: {{ $hostsPerSlice }}" in tpl
+    assert ("alpha.jobset.sigs.k8s.io/exclusive-topology: "
+            "cloud.google.com/gke-nodepool") in tpl
+    assert "TPU.NUM_SLICES={{ $slices }}" in tpl
+    # global-rank env: slice index from the JobSet job-index label,
+    # per-slice size, and the per-slice completion index
+    assert "jobset.sigs.k8s.io/job-index" in tpl
+    assert "PROCS_PER_SLICE" in tpl and "SLICE_INDEX" in tpl
+
+    helpers = _read(f"{chart}/templates/_helpers.tpl")
+    assert "maskrcnn.hostsPerSlice" in helpers
+    assert "fail" in helpers  # hosts % num_slices enforced at render
+    # chips-is-TOTAL enforced at render: chips == slice_chips x slices
+    assert 'trimPrefix "v5e-"' in helpers and "mul $sliceChips" in helpers
+
+
+def test_multislice_rank_composition():
+    """The chart's Multislice env (SLICE_INDEX · PROCS_PER_SLICE +
+    JOB_COMPLETION_INDEX) must compose the same slice-major global
+    order build_mesh gives devices."""
+    from eksml_tpu.parallel.distributed import _rank_from_env
+
+    # single-slice: PROCESS_ID wins verbatim
+    assert _rank_from_env({"PROCESS_ID": "3"}) == 3
+    # multislice: slice-major composition
+    ranks = [_rank_from_env({"SLICE_INDEX": str(s),
+                             "PROCS_PER_SLICE": "4",
+                             "JOB_COMPLETION_INDEX": str(i)})
+             for s in range(2) for i in range(4)]
+    assert ranks == list(range(8))
+    # bare completion index still works (plain indexed Job)
+    assert _rank_from_env({"JOB_COMPLETION_INDEX": "2"}) == 2
+    assert _rank_from_env({}) == 0

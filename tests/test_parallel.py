@@ -21,6 +21,19 @@ def test_validate_topology_names():
         validate_topology("v5e-32", num_chips=16)
 
 
+def test_validate_topology_multislice():
+    """Multislice semantics (chart values contract): topology names
+    EACH slice, num_chips is the TOTAL — validate_topology must scale
+    by num_slices and reject a contradicting total."""
+    assert validate_topology("v5e-16", num_chips=32,
+                             num_slices=2) == (32, 8)
+    assert validate_topology("v5e-32", num_slices=4) == (128, 32)
+    with pytest.raises(ValueError, match="contradicts 2xv5e-16"):
+        validate_topology("v5e-16", num_chips=16, num_slices=2)
+    with pytest.raises(ValueError, match="must be >= 1"):
+        validate_topology("v5e-16", num_slices=0)
+
+
 def test_validate_topology_chip_counts():
     # ≙ the MPIJob CRD schema: gpus ∈ {1,2,4,8k}
     assert validate_topology(num_chips=1) == (1, 1)
