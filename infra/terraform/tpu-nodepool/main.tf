@@ -24,8 +24,18 @@ data "google_container_cluster" "existing" {
   location = var.zone
 }
 
+# One nodepool per slice: num_slices > 1 is GKE Multislice — the
+# training JobSet renders one replicated Job per slice and pins each
+# to its own slice nodepool (charts/maskrcnn values num_slices; the
+# JobSet exclusive-topology annotation matches on
+# cloud.google.com/gke-nodepool).  tpu_hosts and tpu_topology describe
+# EACH slice, matching the chart's topology semantics.
 resource "google_container_node_pool" "tpu" {
-  name       = var.pool_name
+  count = var.num_slices
+  # slice 0 keeps the bare pool_name so scaling num_slices up or down
+  # never renames (= destroys and recreates) a pool that is already
+  # running training hosts; added slices get the -s<N> suffix
+  name       = count.index == 0 ? var.pool_name : "${var.pool_name}-s${count.index}"
   cluster    = data.google_container_cluster.existing.id
   node_count = var.tpu_hosts
 
@@ -74,5 +84,16 @@ variable "tpu_hosts" {
   type    = number
   default = 8
 }
+variable "num_slices" {
+  type        = number
+  default     = 1
+  description = "Multislice: provision one identical slice nodepool per slice (suffix -s<N>)"
+  validation {
+    condition     = var.num_slices >= 1 && var.num_slices <= 64
+    error_message = "num_slices must be between 1 and 64."
+  }
+}
 
-output "nodepool" { value = google_container_node_pool.tpu.name }
+output "nodepools" { value = google_container_node_pool.tpu[*].name }
+# deprecated singular alias (pre-Multislice module interface)
+output "nodepool" { value = google_container_node_pool.tpu[0].name }

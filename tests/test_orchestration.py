@@ -423,3 +423,20 @@ def test_multislice_rank_composition():
     # bare completion index still works (plain indexed Job)
     assert _rank_from_env({"JOB_COMPLETION_INDEX": "2"}) == 2
     assert _rank_from_env({}) == 0
+
+
+def test_terraform_nodepool_supports_multislice():
+    """Infra rung of the Multislice story: the nodepool module must be
+    able to provision one identical slice nodepool per slice (the
+    chart's exclusive-topology annotation then pins each replicated
+    Job to one of them); tpu_hosts/tpu_topology describe EACH slice,
+    matching the chart's per-slice topology semantics."""
+    tf = _read("infra/terraform/tpu-nodepool/main.tf")
+    assert 'variable "num_slices"' in tf
+    assert "count = var.num_slices" in tf
+    # slice 0 keeps the bare name (renames destroy live pools);
+    # added slices are suffixed
+    assert 'count.index == 0 ? var.pool_name' in tf
+    assert "-s${count.index}" in tf
+    assert "var.num_slices >= 1" in tf         # validated range
+    assert "google_container_node_pool.tpu[*].name" in tf
