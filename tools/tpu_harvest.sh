@@ -86,11 +86,13 @@ fi
 if [ ! -s artifacts/convergence_r3.json ]; then
     wait_for_bench_slot
     say "running TPU convergence (full R50-FPN, 512px)"
-    if python tools/convergence_run.py --steps 300 --size 512 \
+    if python tools/convergence_run.py --steps 500 --size 512 \
+        --batch-size 4 \
         --out artifacts/convergence_r3_tpu.json \
         --config RPN.TRAIN_PRE_NMS_TOPK=512 RPN.TRAIN_POST_NMS_TOPK=128 \
         RPN.TEST_PRE_NMS_TOPK=512 RPN.TEST_POST_NMS_TOPK=128 \
-        FRCNN.BATCH_PER_IM=128 >> "$LOG" 2>&1; then
+        FRCNN.BATCH_PER_IM=128 TRAIN.GRADIENT_CLIP=0.36 \
+        >> "$LOG" 2>&1; then
         # promote only a real-accelerator run: with the tunnel down jax
         # silently falls back to CPU, and a CPU run must not be banked
         # as the hardware convergence artifact (same device-kind gate
