@@ -85,13 +85,19 @@ fi
 # run that passes the tool's own convergence asserts is promoted.
 if [ ! -s artifacts/convergence_r3.json ]; then
     wait_for_bench_slot
-    say "running TPU convergence (full R50-FPN, 512px)"
+    # BACKBONE.NORM=GN: the real ladder warm-starts FreezeBN from the
+    # ImageNet npz; with no egress the backbone trains from scratch,
+    # and FreezeBN at random init (unit stats, never updated) cannot
+    # normalize — the round-3 CPU hedge plateaued exactly this way.
+    # GroupNorm is the architecture's supported from-scratch norm.
+    say "running TPU convergence (full R50-FPN, 512px, GN)"
     if python tools/convergence_run.py --steps 500 --size 512 \
         --batch-size 4 \
         --out artifacts/convergence_r3_tpu.json \
         --config RPN.TRAIN_PRE_NMS_TOPK=512 RPN.TRAIN_POST_NMS_TOPK=128 \
         RPN.TEST_PRE_NMS_TOPK=512 RPN.TEST_POST_NMS_TOPK=128 \
         FRCNN.BATCH_PER_IM=128 TRAIN.GRADIENT_CLIP=0.36 \
+        BACKBONE.NORM=GN \
         >> "$LOG" 2>&1; then
         # promote only a real-accelerator run: with the tunnel down jax
         # silently falls back to CPU, and a CPU run must not be banked
