@@ -97,11 +97,10 @@ def test_main_retries_hbm_oom_with_remat(monkeypatch, capsys):
                 "XLA:TPU compile permanent error. Ran out of memory in "
                 "memory space hbm. Used 16.22G of 15.75G hbm.")
         diag["value"] = 7.5
-        bench_mod._emit(diag)
 
     monkeypatch.setattr(bench_mod, "run", fake_run)
     monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
-    bench_mod.main(["--steps", "1"])
+    bench_mod.main(["--single", "--steps", "1"])
     out_lines = [l for l in capsys.readouterr().out.splitlines()
                  if l.strip().startswith("{")]
     assert calls == [False, True]
@@ -125,12 +124,167 @@ def test_main_oom_retry_failure_reports_second_error(monkeypatch,
 
     monkeypatch.setattr(bench_mod, "run", fake_run)
     monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
-    bench_mod.main(["--steps", "1"])
+    bench_mod.main(["--single", "--steps", "1"])
     line = capsys.readouterr().out.strip().splitlines()[-1]
     diag = json.loads(line)
     assert diag["value"] == 0.0
     assert "still too big" in diag["error"]
     assert diag["remat_fallback"] is True
+
+
+def test_grpc_allocation_failure_is_not_hbm_oom():
+    """ADVICE r3: a gRPC 'RESOURCE_EXHAUSTED ... Failed to allocate
+    request buffer' (a tunnel problem) must NOT trigger the remat
+    fallback — only an HBM-marked failure is an operating-point OOM."""
+    tunnel = RuntimeError(
+        "RESOURCE_EXHAUSTED: Failed to allocate request buffer")
+    assert not bench_mod._is_hbm_oom(tunnel)
+    real = RuntimeError(
+        "RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm.")
+    assert bench_mod._is_hbm_oom(real)
+    real2 = RuntimeError("RESOURCE_EXHAUSTED: exceeded HBM capacity")
+    assert bench_mod._is_hbm_oom(real2)
+
+
+def test_ladder_banks_each_rung_and_promotes_headline(monkeypatch,
+                                                      tmp_path, capsys):
+    """Default (no --single) mode runs the cheap-first ladder: every
+    rung banks its own artifact BEFORE the next is attempted, and the
+    single emitted line carries the most expensive successful point
+    (VERDICT r3 next #1)."""
+    import json
+
+    monkeypatch.setattr(bench_mod, "LAST_GOOD",
+                        str(tmp_path / "bench_last_good.json"))
+    seen = []
+
+    def fake_run(args, diag):
+        seen.append((args.image_size, tuple(args.pad_hw or ()),
+                     args.batch_size))
+        diag["value"] = 10.0 * len(seen)
+        diag["mfu"] = 0.1 * len(seen)
+        diag["device_kind"] = "TPU v5 lite"
+
+    monkeypatch.setattr(bench_mod, "run", fake_run)
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "1"])
+    assert seen == [(512, (), 1), (1344, (832, 1344), 4),
+                    (1344, (), 4)]
+    for rung in ("512_b1", "832x1344_b4", "1344_b4"):
+        banked = json.load(open(tmp_path / f"bench_rung_{rung}.json"))
+        assert banked["value"] > 0 and "banked_at" in banked
+    out_lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.strip().startswith("{")]
+    assert len(out_lines) == 1, out_lines
+    diag = json.loads(out_lines[0])
+    assert diag["operating_point"] == "1344_b4"
+    assert diag["headline_point"] is True
+    assert diag["value"] == 30.0
+    assert [r["rung"] for r in diag["rungs"]] == [
+        "512_b1", "832x1344_b4", "1344_b4"]
+
+
+def test_ladder_partial_failure_keeps_cheap_rung(monkeypatch,
+                                                 tmp_path, capsys):
+    """A tunnel that dies after the cheap rung must still leave that
+    rung banked AND reported as the headline value — a short healthy
+    window converts to a nonzero number."""
+    import json
+
+    monkeypatch.setattr(bench_mod, "LAST_GOOD",
+                        str(tmp_path / "bench_last_good.json"))
+
+    def fake_run(args, diag):
+        if args.pad_hw or args.image_size > 512:
+            raise TimeoutError("tunnel hang")
+        diag["value"] = 11.5
+        diag["mfu"] = 0.21
+        diag["device_kind"] = "TPU v5 lite"
+
+    monkeypatch.setattr(bench_mod, "run", fake_run)
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "1"])
+    assert (tmp_path / "bench_rung_512_b1.json").exists()
+    assert not (tmp_path / "bench_rung_1344_b4.json").exists()
+    diag = json.loads(
+        [l for l in capsys.readouterr().out.splitlines()
+         if l.strip().startswith("{")][-1])
+    assert diag["value"] == 11.5
+    assert diag["operating_point"] == "512_b1"
+    assert diag["headline_point"] is False
+    assert diag["ladder_abort"]["rung"] == "832x1344_b4"
+    assert "error" not in diag  # a banked rung is a success, not an error
+
+
+def test_ladder_cpu_run_does_not_clobber_tpu_rung_banks(monkeypatch,
+                                                        tmp_path,
+                                                        capsys):
+    """A CPU smoke of the ladder must leave banked TPU rung artifacts
+    untouched (same hardware-only rule as bench_last_good.json)."""
+    import json
+
+    monkeypatch.setattr(bench_mod, "LAST_GOOD",
+                        str(tmp_path / "bench_last_good.json"))
+    tpu_rec = {"value": 99.0, "device_kind": "TPU v5 lite"}
+    (tmp_path / "bench_rung_512_b1.json").write_text(
+        json.dumps(tpu_rec))
+
+    def fake_run(args, diag):
+        diag["value"] = 1.0
+        diag["device_kind"] = "cpu"
+
+    monkeypatch.setattr(bench_mod, "run", fake_run)
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "1"])
+    banked = json.loads(
+        (tmp_path / "bench_rung_512_b1.json").read_text())
+    assert banked["value"] == 99.0  # untouched
+    capsys.readouterr()
+
+
+def test_ladder_carries_remat_to_larger_rungs(monkeypatch, tmp_path,
+                                              capsys):
+    """Once a rung needed the remat fallback, every larger rung must
+    start WITH remat instead of re-paying a doomed non-remat compile
+    (each compile is minutes over the flaky tunnel)."""
+    calls = []
+
+    def fake_run(args, diag):
+        calls.append((args.image_size, bool(args.pad_hw), args.remat))
+        if args.pad_hw and not args.remat:  # 832x1344 OOMs w/o remat
+            raise RuntimeError("Ran out of memory in memory space hbm")
+        diag["value"] = 5.0
+        diag["device_kind"] = "TPU v5 lite"
+
+    monkeypatch.setattr(bench_mod, "LAST_GOOD",
+                        str(tmp_path / "bench_last_good.json"))
+    monkeypatch.setattr(bench_mod, "run", fake_run)
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "1"])
+    assert calls == [
+        (512, False, False),    # cheap rung: no remat needed
+        (1344, True, False),    # bucket rung: OOM ...
+        (1344, True, True),     # ... retried with remat
+        (1344, False, True),    # headline STARTS with remat
+    ]
+    capsys.readouterr()
+
+
+def test_ladder_total_failure_surfaces_error(monkeypatch, tmp_path,
+                                             capsys):
+    import json
+
+    monkeypatch.setattr(bench_mod, "LAST_GOOD",
+                        str(tmp_path / "bench_last_good.json"))
+    monkeypatch.setattr(bench_mod, "run",
+                        lambda args, diag: (_ for _ in ()).throw(
+                            TimeoutError("backend init exceeded")))
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "1"])
+    diag = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert diag["value"] == 0.0
+    assert "backend init exceeded" in diag["error"]
+    assert diag["ladder_abort"]["rung"] == "512_b1"
 
 
 def test_collective_flag_rollback_on_rejection(monkeypatch):

@@ -93,8 +93,10 @@ def main(argv=None):
             # so the bucket path still runs distinctly (dims % 64 == 0)
             i = extra.index("--pad-hw")
             extra = extra[:i + 1] + ["128", "192"] + extra[i + 3:]
+        # --single: each sweep row measures exactly its named operating
+        # point — bench.py's default is now the escalation ladder
         cmd = [sys.executable, os.path.join(repo, "bench.py"),
-               "--steps", str(args.steps)] + extra
+               "--single", "--steps", str(args.steps)] + extra
         if args.platform:
             cmd += ["--platform", args.platform]
         if args.quick:

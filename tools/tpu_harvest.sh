@@ -1,11 +1,14 @@
 #!/bin/bash
 # One healthy tunnel window must bank EVERY hardware artifact
 # (VERDICT r2: the 3.4x Pallas claim died as prose because nothing was
-# committed in the window that measured it).  This script waits for the
-# patient retry loop's headline success (BENCH_LOCAL.json), then runs
-# the ROIAlign A/B grid and a profiled run, banking each result into
-# artifacts/ as it lands.  Tunnel discipline throughout: clients are
-# never killed; every run waits for any other bench to finish first.
+# committed in the window that measured it; VERDICT r3: the one healthy
+# window died compiling the most expensive point first).  This script
+# waits for the patient retry loop's headline success (BENCH_LOCAL.json
+# — itself now a cheap-first ladder), then harvests in STRICT
+# cheap-first order: the 512px A/B trio, the hardware convergence run,
+# then the expensive A/B pairs and a profiled run — banking each result
+# into artifacts/ as it lands.  Tunnel discipline throughout: clients
+# are never killed; every run waits for any other bench to finish first.
 set -u
 cd "$(dirname "$0")/.."
 LOG=tpu_harvest.log
@@ -25,36 +28,26 @@ wait_for_bench_slot() {
 
 run_bench() {  # run_bench <tag> <args...> -> writes artifacts/<tag>.json
     local tag=$1; shift
+    if [ -s "artifacts/$tag.json" ] && ! grep -q '"error"' \
+        "artifacts/$tag.json" 2>/dev/null; then
+        say "skip $tag: already banked clean"
+        return 0
+    fi
     wait_for_bench_slot
-    say "run $tag: bench.py $*"
-    python bench.py "$@" --init-retries 3 --init-timeout 300 \
-        2>>"$LOG" | tail -1 > "artifacts/$tag.json"
+    say "run $tag: bench.py --single $*"
+    python bench.py --single "$@" --init-retries 3 --init-timeout 300 \
+        2>>"$LOG" | tail -1 > "artifacts/$tag.json.tmp" \
+        && mv "artifacts/$tag.json.tmp" "artifacts/$tag.json"
     say "done $tag: $(head -c 200 "artifacts/$tag.json")"
 }
 
-if [ "$WAIT_HEADLINE" = "1" ]; then
-    say "waiting for BENCH_LOCAL.json (headline via bench_retry_loop)"
-    while [ ! -s BENCH_LOCAL.json ]; do sleep 120; done
-    say "headline landed: $(head -c 200 BENCH_LOCAL.json)"
-fi
-
-# ROIAlign A/B on hardware (VERDICT r2 next #2): square canvas and the
-# 832x1344 bucket canvas, pallas vs xla, plus the backward-kernel A/B
-# (pallas fwd fixed, bwd pallas vs xla).  Short runs; the compile for
-# each variant is paid once into .jax_cache.
-# fwd A/B pins --roi-bwd xla so the forward kernel is the ONLY
-# variable; the bwd pair then varies only the backward
-run_bench roi_ab_pallas_1344   --steps 10 --roi-backend pallas --roi-bwd xla
-run_bench roi_ab_xla_1344      --steps 10 --roi-backend xla --roi-bwd xla
-run_bench roi_ab_pallas_832x1344 --steps 10 --roi-backend pallas --roi-bwd xla --pad-hw 832 1344
-run_bench roi_ab_xla_832x1344  --steps 10 --roi-backend xla --roi-bwd xla --pad-hw 832 1344
-# bwd A/B: compare against roi_ab_pallas_1344 (pallas fwd + xla bwd)
-run_bench roi_ab_bwd_pallas_1344 --steps 10 --roi-backend pallas --roi-bwd pallas
-python - <<'EOF'
+merge_ab() {
+    python - <<'EOF'
 import json, glob
 out = []
+import re
 for p in sorted(glob.glob("artifacts/roi_ab_*.json")):
-    if p.endswith("roi_ab_r3.json"):  # the merged output itself
+    if re.search(r"roi_ab_r\d+\.json$", p):  # merged outputs (any round)
         continue
     try:
         d = json.load(open(p))
@@ -62,56 +55,47 @@ for p in sorted(glob.glob("artifacts/roi_ab_*.json")):
         continue
     out.append({"run": p.split("/")[-1][:-5], **{k: d.get(k) for k in (
         "value", "step_time_ms", "mfu", "roi_backend", "roi_bwd",
-        "image_size", "error")}})
-json.dump({"runs": out}, open("artifacts/roi_ab_r3.json", "w"), indent=1)
-print("merged", len(out), "runs into artifacts/roi_ab_r3.json")
+        "image_size", "batch_size", "device_kind", "error")}})
+json.dump({"runs": out}, open("artifacts/roi_ab_r4.json", "w"), indent=1)
+print("merged", len(out), "runs into artifacts/roi_ab_r4.json")
 EOF
-say "A/B merged into artifacts/roi_ab_r3.json"
+}
 
-# Train-step profile (VERDICT r2 next #5): decide the Pallas-backward
-# go/no-go on a real trace.
-run_bench bench_profiled --steps 10 --profile 8
-if python tools/trace_summary.py profile \
-    --out artifacts/profile_summary_r3.json >> "$LOG" 2>&1; then
-    say "profile summary banked"
-else
-    say "profile summary FAILED — see above; trace left in ./profile"
-fi
-
-# Convergence at real model scale ON HARDWARE (VERDICT r2 next #4):
-# the full R50-FPN run that takes most of a day on the 1-core CPU box
-# finishes in minutes on the chip.  One AP-based gate: run only while
-# no banked artifact shows strong convergence (bbox AP50 >= 0.5 — the
-# convergence FACT is then proven and the slot is better spent on the
-# headline/A-B/profile); promote only a real-accelerator run that does
-# not regress the banked AP50.  Banked to a separate file first so a
-# half-written artifact can never clobber a good one.
-if python -c '
+run_convergence() {
+    # Convergence at real model scale ON HARDWARE (VERDICT r3 next #4):
+    # the full R50-FPN run that takes most of a day on the 1-core CPU
+    # box finishes in minutes on the chip.  Gate: run only while no
+    # banked r4 artifact already shows a non-CPU run beating the r3
+    # CPU-hedge AP50 (0.5284); promote only a real-accelerator run that
+    # does not regress it.  Banked to a separate file first so a
+    # half-written artifact can never clobber a good one.
+    if python -c '
 import json, sys
 try:
-    d = json.load(open("artifacts/convergence_r3.json"))
+    d = json.load(open("artifacts/convergence_r4.json"))
 except Exception:
     sys.exit(0)  # nothing banked: run
-sys.exit(1 if d.get("bbox_AP50", 0) >= 0.5 else 0)
+ok = d.get("device", "cpu").lower() not in ("", "cpu", "host") \
+    and d.get("bbox_AP50", 0) > 0.53
+sys.exit(1 if ok else 0)
 '; then
-    wait_for_bench_slot
-    # BACKBONE.NORM=GN: the real ladder warm-starts FreezeBN from the
-    # ImageNet npz; with no egress the backbone trains from scratch,
-    # and FreezeBN at random init (unit stats, never updated) cannot
-    # normalize — the round-3 CPU hedge plateaued exactly this way.
-    # GroupNorm is the architecture's supported from-scratch norm.
-    say "running TPU convergence (full R50-FPN, 512px, GN)"
-    if python tools/convergence_run.py --steps 500 --size 512 \
-        --batch-size 4 \
-        --out artifacts/convergence_r3_tpu.json \
-        --config RPN.TRAIN_PRE_NMS_TOPK=512 RPN.TRAIN_POST_NMS_TOPK=128 \
-        RPN.TEST_PRE_NMS_TOPK=512 RPN.TEST_POST_NMS_TOPK=128 \
-        FRCNN.BATCH_PER_IM=128 TRAIN.GRADIENT_CLIP=0.36 \
-        BACKBONE.NORM=GN \
-        >> "$LOG" 2>&1; then
-        if reason=$(python -c '
+        wait_for_bench_slot
+        # BACKBONE.NORM=GN: the real ladder warm-starts FreezeBN from
+        # the ImageNet npz; with no egress the backbone trains from
+        # scratch, and FreezeBN at random init cannot normalize — GN is
+        # the architecture's supported from-scratch norm (round 3).
+        say "running TPU convergence (full R50-FPN, 512px, GN)"
+        if python tools/convergence_run.py --steps 600 --size 512 \
+            --batch-size 4 \
+            --out artifacts/convergence_r4_tpu.json \
+            --config RPN.TRAIN_PRE_NMS_TOPK=512 RPN.TRAIN_POST_NMS_TOPK=128 \
+            RPN.TEST_PRE_NMS_TOPK=512 RPN.TEST_POST_NMS_TOPK=128 \
+            FRCNN.BATCH_PER_IM=128 TRAIN.GRADIENT_CLIP=0.36 \
+            BACKBONE.NORM=GN \
+            >> "$LOG" 2>&1; then
+            if reason=$(python -c '
 import json, sys
-d = json.load(open("artifacts/convergence_r3_tpu.json"))
+d = json.load(open("artifacts/convergence_r4_tpu.json"))
 if d.get("device", "").lower() in ("", "cpu", "host"):
     print("ran on CPU fallback"); sys.exit(1)
 try:
@@ -123,16 +107,83 @@ if d.get("bbox_AP50", 0) < old.get("bbox_AP50", 0):
         d.get("bbox_AP50", 0), old.get("bbox_AP50", 0)))
     sys.exit(1)
 '); then
-            cp artifacts/convergence_r3_tpu.json \
-               artifacts/convergence_r3.json
-            say "TPU convergence banked as convergence_r3.json"
+                cp artifacts/convergence_r4_tpu.json \
+                   artifacts/convergence_r4.json
+                say "TPU convergence banked as convergence_r4.json"
+            else
+                say "TPU convergence NOT promoted: $reason"
+            fi
         else
-            say "TPU convergence NOT promoted: $reason"
+            say "TPU convergence run FAILED its own checks (see log)"
         fi
     else
-        say "TPU convergence run FAILED its own checks (see log)"
+        say "convergence_r4.json already strong on hardware; skipping"
     fi
+}
+
+if [ "$WAIT_HEADLINE" = "1" ]; then
+    say "waiting for BENCH_LOCAL.json (ladder via bench_retry_loop)"
+    while [ ! -s BENCH_LOCAL.json ]; do sleep 120; done
+    say "headline landed: $(head -c 200 BENCH_LOCAL.json)"
+fi
+
+# ---- Rung 1 (cheap, lands in minutes): 512px A/B trio -------------
+# fwd A/B pins --roi-bwd xla so the forward kernel is the ONLY
+# variable; the bwd run then varies only the backward.
+run_bench roi_ab_pallas_512 --steps 10 --image-size 512 \
+    --roi-backend pallas --roi-bwd xla
+run_bench roi_ab_xla_512 --steps 10 --image-size 512 \
+    --roi-backend xla --roi-bwd xla
+run_bench roi_ab_bwd_pallas_512 --steps 10 --image-size 512 \
+    --roi-backend pallas --roi-bwd pallas
+merge_ab
+say "cheap A/B trio merged"
+
+# ---- Rung 2: hardware convergence (minutes on-chip) ----------------
+run_convergence
+
+# ---- Rung 3: production-shape A/B pairs ----------------------------
+run_bench roi_ab_pallas_832x1344 --steps 10 --roi-backend pallas \
+    --roi-bwd xla --pad-hw 832 1344
+run_bench roi_ab_xla_832x1344 --steps 10 --roi-backend xla \
+    --roi-bwd xla --pad-hw 832 1344
+run_bench roi_ab_pallas_1344 --steps 10 --roi-backend pallas --roi-bwd xla
+run_bench roi_ab_xla_1344 --steps 10 --roi-backend xla --roi-bwd xla
+run_bench roi_ab_bwd_pallas_1344 --steps 10 --roi-backend pallas \
+    --roi-bwd pallas
+merge_ab
+say "full A/B grid merged into artifacts/roi_ab_r4.json"
+
+# ---- Rung 4: train-step profile (go/no-go on a real trace) ---------
+run_bench bench_profiled --steps 10 --profile 8
+if python tools/trace_summary.py profile \
+    --out artifacts/profile_summary_r4.json >> "$LOG" 2>&1; then
+    say "profile summary banked"
 else
-    say "convergence_r3.json already strong (AP50>=0.5); skipping"
+    say "profile summary FAILED — see above; trace left in ./profile"
+fi
+# ---- Rung 5: headline retry if the banked ladder stopped short ----
+# Every A/B compile above warmed .jax_cache, so a full ladder rerun is
+# mostly dispatch; only upgrade BENCH_LOCAL when the 1344/b4 point
+# actually landed on hardware.
+if ! python -c '
+import json, sys
+d = json.load(open("BENCH_LOCAL.json"))
+sys.exit(0 if d.get("headline_point") else 1)' 2>/dev/null; then
+    wait_for_bench_slot
+    say "retrying full ladder for the headline point"
+    python bench.py --steps 20 --init-retries 3 --init-timeout 300 \
+        2>>"$LOG" | tail -1 > artifacts/bench_ladder_retry.json
+    if python -c '
+import json, sys
+d = json.load(open("artifacts/bench_ladder_retry.json"))
+ok = d.get("value", 0) > 0 and d.get("headline_point") and \
+    d.get("device_kind", "").lower() not in ("", "cpu", "host")
+sys.exit(0 if ok else 1)'; then
+        cp artifacts/bench_ladder_retry.json BENCH_LOCAL.json
+        say "headline point upgraded into BENCH_LOCAL.json"
+    else
+        say "headline retry did not land; keeping banked ladder result"
+    fi
 fi
 say "harvest complete"
