@@ -194,6 +194,21 @@ def main(argv=None):
                    help="KEY=VALUE overrides")
     args = p.parse_args(argv)
 
+    # ladder mode dictates its own operating points — refuse silently
+    # ignored point flags rather than bench something the caller did
+    # not ask for (use --single to pin a point)
+    if not args.single:
+        ignored = [f for f, dflt in (("--image-size", 1344),
+                                     ("--batch-size", 4))
+                   if getattr(args, f[2:].replace("-", "_")) != dflt]
+        if args.pad_hw is not None:
+            ignored.append("--pad-hw")
+        if args.profile:
+            ignored.append("--profile")
+        if ignored:
+            p.error(f"{', '.join(ignored)} only apply with --single; "
+                    "default mode runs the fixed cheap-first ladder")
+
     os.environ["EKSML_ROI_BACKEND"] = args.roi_backend
     os.environ["EKSML_ROI_BWD"] = args.roi_bwd
 

@@ -157,7 +157,19 @@ def _run_outside_any_trace(probe, dtype) -> bool:
 
     t = threading.Thread(target=_worker, name="pallas-probe", daemon=True)
     t.start()
-    t.join()
+    # Bounded join (ADVICE r3): a wedged TPU runtime can hang the probe
+    # compile indefinitely; bench.py deadlines jax.devices() for exactly
+    # this tunnel failure mode, and the probe needs the same guard.  A
+    # still-alive thread counts as probe-fail (the daemon thread is
+    # safely abandoned) so trainer init degrades to XLA instead of
+    # hanging with no diagnostic.
+    t.join(timeout=float(os.environ.get("EKSML_PROBE_TIMEOUT", "120")))
+    if t.is_alive():
+        log.warning("Pallas probe for %s still running after its "
+                    "deadline (wedged runtime?); treating as "
+                    "unsupported and falling back to XLA",
+                    np.dtype(dtype))
+        return False
     return result["ok"]
 
 

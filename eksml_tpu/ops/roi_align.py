@@ -22,6 +22,7 @@ offset), which is what modern Mask-RCNN implementations use.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Sequence
 
@@ -42,12 +43,23 @@ _ROI_CHUNK = int(os.environ.get("EKSML_ROI_CHUNK", "128"))
 def _chunk_size(n: int) -> int | None:
     """Largest divisor of ``n`` that is ≤ the chunk bound (static shape
     arithmetic — runs at trace time), or None when chunking is off or
-    pointless (n within bound, or n prime)."""
+    pointless (n within bound, or n prime).  The prime-N case is loud
+    (ADVICE r3): silently reinstating the full [N,out,s,out,s,C] temps
+    is how the round-3 HBM OOM happened, and a config override landing
+    on e.g. 509 ROIs must leave a runtime signal."""
     c = _ROI_CHUNK
     if c <= 0 or n <= c:
         return None
     best = max(d for d in range(1, c + 1) if n % d == 0)
-    return best if best > 1 else None
+    if best <= 1:
+        logging.getLogger(__name__).warning(
+            "ROIAlign chunking requested (EKSML_ROI_CHUNK=%d) but %d "
+            "ROIs has no divisor in (1, %d] — running UNCHUNKED; the "
+            "full gather temps may OOM HBM at large canvases. Pick an "
+            "ROI count with a divisor <= the bound (powers of two are "
+            "safe).", c, n, c)
+        return None
+    return best
 
 
 def _bilinear_gather(feat: jnp.ndarray, y: jnp.ndarray, x: jnp.ndarray):

@@ -12,13 +12,17 @@ check the reference cannot do: asserting replicas actually agree
 
 from __future__ import annotations
 
+import logging
 import os
+import time
 from typing import Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
+
+log = logging.getLogger(__name__)
 
 
 def set_xla_collective_flags(combine_threshold_bytes: int,
@@ -92,9 +96,17 @@ def warm_mesh_collectives(mesh: Mesh) -> None:
                     f"mesh warm-up all-reduce returned {out}, "
                     f"expected {n} — collective context is broken")
             return
-        except Exception:  # noqa: BLE001 — one retry, then surface
+        except Exception as e:  # noqa: BLE001 — one retry, then surface
             if attempt == 2:
                 raise
+            # ADVICE r3: log the first failure (and back off briefly)
+            # so a transient-then-fatal connect failure leaves a record
+            # of the retry in the multihost logs, not just the second
+            # exception.
+            log.warning("mesh warm-up all-reduce failed "
+                        "(%s: %s); retrying once in 2s",
+                        type(e).__name__, e)
+            time.sleep(2.0)
 
 
 def cross_host_sum(tree):

@@ -64,7 +64,17 @@ def _rank_from_env(env) -> int:
     (slice-major, matching build_mesh's slice-major device order)."""
     if "PROCESS_ID" in env:
         return int(env["PROCESS_ID"])
-    if "SLICE_INDEX" in env and "PROCS_PER_SLICE" in env:
+    if "SLICE_INDEX" in env:
+        # Fail fast on a partial Multislice env (ADVICE r3): silently
+        # falling through to the bare per-slice completion index would
+        # collide ranks across slices at rendezvous — a hang at
+        # initialize(), hours later, with no pointer to the bad chart.
+        if "PROCS_PER_SLICE" not in env:
+            raise RuntimeError(
+                "SLICE_INDEX is set but PROCS_PER_SLICE is not: the "
+                "Multislice rank is SLICE_INDEX*PROCS_PER_SLICE + "
+                "JOB_COMPLETION_INDEX; a partial env would collide "
+                "ranks across slices. Fix the JobSet template env.")
         return (int(env["SLICE_INDEX"]) * int(env["PROCS_PER_SLICE"])
                 + int(env.get("JOB_COMPLETION_INDEX", "0")))
     return int(env.get("JOB_COMPLETION_INDEX", "0"))

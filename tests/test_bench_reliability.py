@@ -270,6 +270,18 @@ def test_ladder_carries_remat_to_larger_rungs(monkeypatch, tmp_path,
     capsys.readouterr()
 
 
+def test_point_flags_require_single():
+    """Explicit operating-point flags without --single must fail fast
+    (the ladder would silently override them — benching a point the
+    caller did not ask for)."""
+    import pytest as _pytest
+
+    for argv in (["--image-size", "512"], ["--batch-size", "1"],
+                 ["--pad-hw", "832", "1344"], ["--profile", "4"]):
+        with _pytest.raises(SystemExit):
+            bench_mod.main(argv)
+
+
 def test_ladder_total_failure_surfaces_error(monkeypatch, tmp_path,
                                              capsys):
     import json

@@ -425,6 +425,19 @@ def test_multislice_rank_composition():
     assert _rank_from_env({}) == 0
 
 
+def test_partial_multislice_env_fails_fast():
+    """ADVICE r3: SLICE_INDEX without PROCS_PER_SLICE must raise, not
+    silently return the per-slice completion index — that collides
+    ranks across slices and hangs rendezvous with no diagnostic."""
+    import pytest as _pytest
+
+    from eksml_tpu.parallel.distributed import _rank_from_env
+
+    with _pytest.raises(RuntimeError, match="PROCS_PER_SLICE"):
+        _rank_from_env({"SLICE_INDEX": "1",
+                        "JOB_COMPLETION_INDEX": "2"})
+
+
 def test_terraform_nodepool_supports_multislice():
     """Infra rung of the Multislice story: the nodepool module must be
     able to provision one identical slice nodepool per slice (the

@@ -225,6 +225,22 @@ def test_gate_probe_survives_mid_trace(monkeypatch):
     assert float(res) == 1.0
 
 
+def test_probe_thread_join_is_bounded(monkeypatch):
+    """ADVICE r3: a wedged TPU runtime hanging the probe compile must
+    convert to probe-fail after the deadline (daemon thread abandoned),
+    not hang trainer init forever with no diagnostic."""
+    import time as _time
+
+    from eksml_tpu.ops.pallas import roi_align_kernel as rk
+
+    monkeypatch.setenv("EKSML_PROBE_TIMEOUT", "0.2")
+    t0 = _time.time()
+    ok = rk._run_outside_any_trace(
+        lambda dtype: _time.sleep(60) or True, jnp.float32)
+    assert ok is False
+    assert _time.time() - t0 < 10  # returned at the deadline, not 60s
+
+
 def test_gate_probe_runs_pallas_call_mid_trace(monkeypatch):
     """Round-3 hardware regression: ``jax.ensure_compile_time_eval()``
     escapes the OUTER trace but corrupts ``pallas_call``'s inner kernel

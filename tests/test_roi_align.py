@@ -119,3 +119,25 @@ def test_roi_chunking_identical_values_and_grads(monkeypatch):
         np.testing.assert_allclose(got_out, ref_out, atol=1e-6)
         for a, b in zip(got_g, ref_g):
             np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_roi_chunking_prime_n_warns(monkeypatch, caplog):
+    """ADVICE r3: when chunking is requested but N has no divisor in
+    the bound (prime N from a config override), silently reinstating
+    the full gather temps is the exact round-3 OOM path — it must leave
+    a runtime warning."""
+    import importlib
+    import logging
+
+    ra = importlib.import_module("eksml_tpu.ops.roi_align")
+    monkeypatch.setattr(ra, "_ROI_CHUNK", 128)
+    with caplog.at_level(logging.WARNING,
+                         logger="eksml_tpu.ops.roi_align"):
+        assert ra._chunk_size(509) is None  # prime > bound
+    assert any("UNCHUNKED" in r.message for r in caplog.records)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING,
+                         logger="eksml_tpu.ops.roi_align"):
+        assert ra._chunk_size(512) == 128   # clean divisor: silent
+        assert ra._chunk_size(64) is None   # within bound: silent
+    assert not caplog.records

@@ -28,9 +28,18 @@ wait_for_bench_slot() {
 
 run_bench() {  # run_bench <tag> <args...> -> writes artifacts/<tag>.json
     local tag=$1; shift
-    if [ -s "artifacts/$tag.json" ] && ! grep -q '"error"' \
-        "artifacts/$tag.json" 2>/dev/null; then
-        say "skip $tag: already banked clean"
+    # skip only artifacts that are clean AND from real hardware — a
+    # CPU-fallback success must not block the hardware measurement
+    if python -c '
+import json, sys
+try:
+    d = json.load(open("artifacts/" + sys.argv[1] + ".json"))
+except Exception:
+    sys.exit(1)
+ok = "error" not in d and d.get("value", 0) > 0 and \
+    d.get("device_kind", "").lower() not in ("", "cpu", "host")
+sys.exit(0 if ok else 1)' "$tag" 2>/dev/null; then
+        say "skip $tag: already banked clean on hardware"
         return 0
     fi
     wait_for_bench_slot
