@@ -198,9 +198,9 @@ def main(argv=None):
     # ignored point flags rather than bench something the caller did
     # not ask for (use --single to pin a point)
     if not args.single:
-        ignored = [f for f, dflt in (("--image-size", 1344),
-                                     ("--batch-size", 4))
-                   if getattr(args, f[2:].replace("-", "_")) != dflt]
+        ignored = [f for f in ("--image-size", "--batch-size")
+                   if getattr(args, f[2:].replace("-", "_"))
+                   != p.get_default(f[2:].replace("-", "_"))]
         if args.pad_hw is not None:
             ignored.append("--pad-hw")
         if args.profile:
