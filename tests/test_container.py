@@ -11,15 +11,18 @@ THE versions this test suite runs against — the tested stack is the
 shipped stack.
 """
 
+import glob
 import os
 import re
 from importlib.metadata import version
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONSTRAINTS = os.path.join(REPO, "container", "constraints.txt")
-DOCKERFILES = [os.path.join(REPO, d, "Dockerfile")
-               for d in ("container", "container-optimized",
-                         "container-viz", "container-optimized-viz")]
+# glob, not an enumerated list: a future container-*/Dockerfile must
+# not silently bypass the every-install-is-constrained invariant
+DOCKERFILES = sorted(glob.glob(os.path.join(REPO, "container*",
+                                            "Dockerfile")))
+assert len(DOCKERFILES) >= 4, DOCKERFILES
 
 
 def _pins():

@@ -237,3 +237,34 @@ def test_gn_and_bf16_variants(fresh_config):
     assert all(np.isfinite(float(v)) for v in losses.values()), losses
     # losses stay f32 even under bf16 compute
     assert losses["total_loss"].dtype == jnp.float32
+
+
+def test_mask_targets_identity_and_subregion_resample():
+    """Pin the mask-target resampling semantics (VERDICT r3 next #4
+    suspect): a ROI equal to its matched GT box must reproduce the
+    stored bbox-cropped mask exactly, and a ROI covering one quadrant
+    of the GT box must reproduce that quadrant — any half-pixel shift
+    or axis swap here silently degrades segm AP while bbox AP stays
+    healthy."""
+    model = tiny_model(mask_resolution=28)
+    mr0 = 28
+    rng = np.random.RandomState(3)
+    # blocky 7x7 pattern upsampled 4x: piecewise-constant regions make
+    # the identity resample exact under bilinear sampling
+    coarse = (rng.rand(7, 7) > 0.5).astype(np.float32)
+    stored = np.kron(coarse, np.ones((4, 4), np.float32))  # [28,28]
+    gt_boxes = jnp.asarray([[10.0, 20.0, 74.0, 116.0]])    # w=64 h=96
+    gt_masks = jnp.asarray(stored)[None]                   # [1,28,28]
+    matched = jnp.zeros((2,), jnp.int32)
+    rois = jnp.asarray([
+        [10.0, 20.0, 74.0, 116.0],   # identical to the GT box
+        [10.0, 20.0, 42.0, 68.0],    # top-left quadrant
+    ])
+    out = model.apply({}, rois, matched, gt_boxes, gt_masks,
+                      method=MaskRCNN._mask_targets)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[0], stored)
+    # quadrant ROI: top-left 14x14 of the stored mask, upsampled 2x
+    want = np.kron(stored[:14, :14], np.ones((2, 2)))
+    np.testing.assert_array_equal(out[1], (want >= 0.5).astype(
+        np.float32))

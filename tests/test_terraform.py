@@ -1,0 +1,147 @@
+"""Terraform contract tests (VERDICT r3 next #8).
+
+The reference's only machine validation was the MPIJob CRD schema
+(charts/mpijob/templates/mpijob.yaml:16-50); its Terraform was prose.
+These tests parse the three provisioner modules with the in-tree HCL
+parser (tools/hcl_lite — python-hcl2 is not installable here) and
+assert the resource/variable/output contract the rest of the repo
+depends on: breaking `tpu-nodepool/main.tf` fails the suite the same
+way breaking a chart fails test_orchestration.
+"""
+
+import os
+import re
+
+import tools.hcl_lite as hcl
+
+TF = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "infra", "terraform")
+
+
+def _module(name):
+    blocks = []
+    d = os.path.join(TF, name)
+    for f in sorted(os.listdir(d)):
+        if f.endswith(".tf"):
+            blocks += hcl.parse(os.path.join(d, f))
+    return blocks
+
+
+def _resources(blocks):
+    return {tuple(b.labels): b for b in hcl.blocks_of(blocks, "resource")}
+
+
+def _one(blocks, btype, *labels):
+    got = [b for b in hcl.blocks_of(blocks, btype)
+           if tuple(b.labels) == labels]
+    assert len(got) == 1, (btype, labels, [b.labels for b in blocks])
+    return got[0]
+
+
+# ---- combined module (≙ aws-eks-cluster-and-nodegroup.tf) -----------
+
+def test_combined_module_resource_contract():
+    blocks = _module("gke-tpu-cluster")
+    res = _resources(blocks)
+    for want in [("google_compute_network", "vpc"),
+                 ("google_compute_subnetwork", "subnet"),
+                 ("google_compute_firewall", "intra"),
+                 ("google_filestore_instance", "shared"),
+                 ("google_container_cluster", "cluster"),
+                 ("google_container_node_pool", "system"),
+                 ("google_container_node_pool", "tpu")]:
+        assert want in res, f"missing resource {want}"
+
+    # TPU pool: node count and topology come from the variables the
+    # README documents; placement is a COMPACT podslice
+    tpu = res[("google_container_node_pool", "tpu")]
+    assert tpu.attrs["node_count"] == "var.tpu_hosts"
+    placement = _one(tpu.blocks, "placement_policy")
+    assert placement.attrs["tpu_topology"] == "var.tpu_topology"
+    assert '"COMPACT"' in placement.attrs["type"]
+
+    # kubeconfig local-exec (≙ reference aws eks update-kubeconfig
+    # :276-278)
+    cluster = res[("google_container_cluster", "cluster")]
+    prov = _one(cluster.blocks, "provisioner", "local-exec")
+    assert "get-credentials" in prov.attrs["command"]
+    assert cluster.attrs["remove_default_node_pool"] == "true"
+
+    # shared fs on the cluster VPC
+    fs = res[("google_filestore_instance", "shared")]
+    nets = _one(fs.blocks, "networks")
+    assert "google_compute_network.vpc" in nets.attrs["network"]
+
+
+def test_combined_module_variables_and_outputs():
+    blocks = _module("gke-tpu-cluster")
+    variables = {b.labels[0] for b in hcl.blocks_of(blocks, "variable")}
+    for v in ("project", "cluster_name", "zone", "tpu_machine_type",
+              "tpu_topology", "tpu_hosts", "filestore_capacity_gb",
+              "subnet_cidr"):
+        assert v in variables, f"missing variable {v}"
+
+    outputs = {b.labels[0]: b for b in hcl.blocks_of(blocks, "output")}
+    for o in ("summary", "filestore_ip", "shared_fs_manifests"):
+        assert o in outputs, f"missing output {o}"
+    # rendered PV/PVC (≙ aws-eks-nodegroup.tf:273-348): RWX NFS pair
+    # pointing at the Filestore export
+    manifests = outputs["shared_fs_manifests"].body
+    assert "kind: PersistentVolume" in manifests
+    assert "kind: PersistentVolumeClaim" in manifests
+    assert "ReadWriteMany" in manifests
+    assert "google_filestore_instance.shared" in manifests
+
+
+# ---- nodepool-only module (≙ aws-eks-nodegroup.tf) ------------------
+
+def test_nodepool_module_multislice_contract():
+    blocks = _module("tpu-nodepool")
+    # attaches to an EXISTING cluster via data lookup (≙ the
+    # data aws_eks_cluster lookup :114-116)
+    _one(hcl.blocks_of(blocks, "data"), "data",
+         "google_container_cluster", "existing")
+
+    tpu = _one(blocks, "resource", "google_container_node_pool", "tpu")
+    # one nodepool per slice — THE Multislice infra rung
+    assert tpu.attrs["count"] == "var.num_slices"
+    assert tpu.attrs["node_count"] == "var.tpu_hosts"
+    # slice 0 keeps the bare name (no destroy/recreate on scale-out)
+    assert re.search(r"count\.index\s*==\s*0\s*\?", tpu.attrs["name"])
+    placement = _one(tpu.blocks, "placement_policy")
+    assert placement.attrs["tpu_topology"] == "var.tpu_topology"
+
+    ns = _one(blocks, "variable", "num_slices")
+    validation = _one(ns.blocks, "validation")
+    assert "var.num_slices >= 1" in validation.attrs["condition"]
+
+    outputs = {b.labels[0]: b for b in hcl.blocks_of(blocks, "output")}
+    assert "[*].name" in outputs["nodepools"].attrs["value"]
+
+
+# ---- cluster-only module (≙ aws-eks-cluster.tf) ---------------------
+
+def test_cluster_only_module_has_no_tpu_pool():
+    blocks = _module("gke-cluster")
+    res = _resources(blocks)
+    assert ("google_container_cluster", "cluster") in res
+    assert ("google_filestore_instance", "shared") in res
+    # the split-provisioning contract: TPU pools come from tpu-nodepool
+    assert ("google_container_node_pool", "tpu") not in res
+
+
+# ---- the harness itself ---------------------------------------------
+
+def test_hcl_parser_handles_heredoc_and_interpolation(tmp_path):
+    p = tmp_path / "x.tf"
+    p.write_text(
+        'output "o" {\n'
+        '  value = <<-EOT\n'
+        '    a { not-a-block } ${var.x == "}" ? 1 : 2}\n'
+        '  EOT\n'
+        '}\n'
+        '# comment { with brace\n'
+        'resource "a" "b" { k = "${foo["}"]}" }\n')
+    blocks = hcl.parse(str(p))
+    assert [b.btype for b in blocks] == ["output", "resource"]
+    assert blocks[1].labels == ("a", "b")

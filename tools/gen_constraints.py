@@ -18,10 +18,14 @@ from __future__ import annotations
 import re
 from importlib.metadata import PackageNotFoundError, distribution
 
-# the packages named in the Dockerfiles' pip install lines
-ROOTS = ["jax", "jaxlib", "libtpu", "flax", "optax", "orbax-checkpoint",
-         "einops", "numpy", "ml_dtypes", "pillow",
-         "jupyterlab", "matplotlib"]
+# the packages named in the Dockerfiles' pip install lines, with the
+# extras those lines request — jax[tpu]'s extras-gated deps (libtpu,
+# requests) must be pinned through THIS root, not by coincidence via
+# an unrelated closure member
+ROOTS = [("jax", ("tpu",)), ("jaxlib", ()), ("libtpu", ()),
+         ("flax", ()), ("optax", ()), ("orbax-checkpoint", ()),
+         ("einops", ()), ("numpy", ()), ("ml_dtypes", ()),
+         ("pillow", ()), ("jupyterlab", ()), ("matplotlib", ())]
 
 HEADER = """\
 # Pinned engine stack for the training/viz images (VERDICT r3 next #3).
@@ -47,9 +51,9 @@ def _norm(name: str) -> str:
 
 def closure(roots=ROOTS) -> dict[str, tuple[str, str]]:
     seen: dict[str, tuple[str, str]] = {}
-    queue = list(roots)
+    queue = [(n, tuple(extras)) for n, extras in roots]
     while queue:
-        name = queue.pop()
+        name, extras = queue.pop()
         key = _norm(name)
         if key in seen:
             continue
@@ -59,13 +63,19 @@ def closure(roots=ROOTS) -> dict[str, tuple[str, str]]:
             continue  # not installed here -> pip resolves it fresh
         seen[key] = (dist.metadata["Name"], dist.version)
         for req in dist.requires or []:
-            # skip extras-gated deps: a plain `pip install pkg`
-            # does not resolve them
-            if ";" in req and "extra" in req.split(";")[-1]:
-                continue
+            # extras-gated deps are only resolved when that extra is
+            # requested (jax[tpu] → libtpu/requests; plain deps of the
+            # closure never request extras of their own deps here)
+            if ";" in req:
+                marker = req.split(";", 1)[1]
+                if "extra" in marker and not any(
+                        f'extra == "{e}"' in marker
+                        or f"extra == '{e}'" in marker
+                        for e in extras):
+                    continue
             m = re.match(r"\s*([A-Za-z0-9_.-]+)", req)
             if m:
-                queue.append(m.group(1))
+                queue.append((m.group(1), ()))
     return seen
 
 

@@ -1,0 +1,148 @@
+"""Minimal HCL block parser for Terraform contract tests.
+
+python-hcl2 is not in the baked environment and nothing may be
+installed (environment rule), so this ~100-line parser extracts the
+structure the tests assert on: top-level blocks (``resource``/
+``variable``/``output``/``data``/…) with their labels, nested block
+types, and attribute assignment source text.  It understands comments
+(``#``, ``//``, ``/* */``), quoted strings with ``${}`` interpolation,
+and indented heredocs (``<<-EOT``) — the full syntax the repo's
+``infra/terraform`` modules use.  It is NOT a general HCL parser and
+asserts on unbalanced input rather than guessing.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class Block:
+    btype: str                     # resource / variable / output / ...
+    labels: Tuple[str, ...]        # e.g. ("google_container_cluster", "cluster")
+    body: str                      # raw body text (between braces)
+    blocks: List["Block"] = field(default_factory=list)   # nested
+
+    @property
+    def attrs(self) -> Dict[str, str]:
+        """Top-level ``name = <raw text>`` assignments in this body
+        (nested block bodies excluded)."""
+        depth = 0
+        out: Dict[str, str] = {}
+        for line in self.body.splitlines():
+            stripped = line.strip()
+            if depth == 0:
+                m = re.match(r"([A-Za-z_][A-Za-z0-9_-]*)\s*=\s*(.+)$",
+                             stripped)
+                if m and not stripped.startswith("#"):
+                    out[m.group(1)] = m.group(2).strip()
+            depth += line.count("{") - line.count("}")
+            depth = max(depth, 0)
+        return out
+
+
+def _strip_comments(text: str) -> str:
+    out: List[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        if ch == '"':                      # quoted string: copy verbatim
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            out.append(text[i:j + 1])
+            i = j + 1
+        elif text.startswith("<<", i):     # heredoc: copy to terminator
+            m = re.match(r"<<-?([A-Za-z_][A-Za-z0-9_]*)", text[i:])
+            if not m:
+                out.append(ch)
+                i += 1
+                continue
+            tag = m.group(1)
+            end = re.search(rf"^\s*{tag}\s*$", text[i:], re.M)
+            stop = i + (end.end() if end else len(text) - i)
+            out.append(text[i:stop])
+            i = stop
+        elif ch == "#" or text.startswith("//", i):
+            i = text.find("\n", i)
+            i = n if i < 0 else i
+        elif text.startswith("/*", i):
+            j = text.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _find_matching_brace(text: str, start: int) -> int:
+    """Index of the ``}`` closing the ``{`` at ``start`` (comment-free
+    input; strings/heredocs may contain braces via ``${}``)."""
+    depth = 0
+    i, n = start, len(text)
+    while i < n:
+        ch = text[i]
+        if ch == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                if text[j] == "\\":
+                    j += 1
+                elif text.startswith("${", j):   # interpolation nests
+                    d = 1
+                    j += 2
+                    while j < n and d:
+                        d += text[j] == "{"
+                        d -= text[j] == "}"
+                        j += 1
+                    continue
+                j += 1
+            i = j + 1
+            continue
+        if text.startswith("<<", i):
+            m = re.match(r"<<-?([A-Za-z_][A-Za-z0-9_]*)", text[i:])
+            if m:
+                end = re.search(rf"^\s*{m.group(1)}\s*$", text[i:], re.M)
+                i += end.end() if end else n - i
+                continue
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+        i += 1
+    raise ValueError("unbalanced braces in HCL input")
+
+
+def _parse_blocks(text: str) -> List[Block]:
+    blocks: List[Block] = []
+    pat = re.compile(
+        r'([A-Za-z_][A-Za-z0-9_-]*)((?:\s+"[^"]*")*)\s*\{')
+    i = 0
+    while True:
+        m = pat.search(text, i)
+        if not m:
+            break
+        open_at = m.end() - 1
+        close_at = _find_matching_brace(text, open_at)
+        labels = tuple(re.findall(r'"([^"]*)"', m.group(2)))
+        body = text[open_at + 1:close_at]
+        blk = Block(m.group(1), labels, body)
+        blk.blocks = _parse_blocks(body)
+        blocks.append(blk)
+        i = close_at + 1
+    return blocks
+
+
+def parse(path: str) -> List[Block]:
+    """Parse one ``.tf`` file into its top-level blocks."""
+    return _parse_blocks(_strip_comments(open(path).read()))
+
+
+def blocks_of(blocks: List[Block], btype: str,
+              label0: str | None = None) -> List[Block]:
+    return [b for b in blocks
+            if b.btype == btype
+            and (label0 is None or (b.labels and b.labels[0] == label0))]
