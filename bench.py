@@ -299,10 +299,29 @@ def run_ladder(args, diag: dict) -> None:
     the most expensive rung that succeeded, plus a per-rung summary."""
     import traceback
 
+    # EKSML_BENCH_RUNGS=name[,name…] subsets the ladder — the CPU
+    # integration drive runs the REAL rung loop on one cheap rung with
+    # shrunken --config widths instead of faking run()
+    keep = os.environ.get("EKSML_BENCH_RUNGS", "")
+    if keep:
+        names = [t.strip() for t in keep.split(",") if t.strip()]
+        known = {r["name"] for r in RUNGS}
+        bad = [n for n in names if n not in known]
+        if bad or not names:
+            # every requested name must resolve — a typo silently
+            # dropping the headline rung must fail loudly, not bench
+            # a subset the caller didn't ask for
+            raise ValueError(
+                f"EKSML_BENCH_RUNGS={keep!r}: unknown rung(s) {bad} "
+                f"(known: {sorted(known)})")
+        rungs = [r for r in RUNGS if r["name"] in names]
+    else:
+        rungs = list(RUNGS)
+
     rung_summaries = []
     best = None
     carry_remat = args.remat
-    for rung in RUNGS:
+    for rung in rungs:
         ra = argparse.Namespace(**vars(args))
         ra.image_size = rung["image_size"]
         ra.pad_hw = rung["pad_hw"]

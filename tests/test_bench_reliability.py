@@ -270,6 +270,45 @@ def test_ladder_carries_remat_to_larger_rungs(monkeypatch, tmp_path,
     capsys.readouterr()
 
 
+def test_ladder_rung_subset_env(monkeypatch, tmp_path, capsys):
+    """EKSML_BENCH_RUNGS subsets the ladder (the CPU integration
+    drive's hook); an unknown name fails loudly instead of silently
+    benching nothing."""
+    import json
+
+    monkeypatch.setattr(bench_mod, "LAST_GOOD",
+                        str(tmp_path / "bench_last_good.json"))
+    seen = []
+
+    def fake_run(args, diag):
+        seen.append(args.batch_size)
+        diag["value"] = 1.0
+        diag["device_kind"] = "TPU v5 lite"
+
+    monkeypatch.setattr(bench_mod, "run", fake_run)
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    monkeypatch.setenv("EKSML_BENCH_RUNGS", "512_b1")
+    bench_mod.main(["--steps", "1"])
+    assert seen == [1]  # only the cheap rung ran
+    diag = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert diag["operating_point"] == "512_b1"
+
+    # a typo must fail loudly even when OTHER names matched — silently
+    # dropping the headline rung would mask a mis-set env for a round
+    for bad in ("nope", "512_b1, 1344b4"):
+        monkeypatch.setenv("EKSML_BENCH_RUNGS", bad)
+        bench_mod.main(["--steps", "1"])
+        diag = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert "unknown rung" in diag["error"], diag
+    # whitespace-padded VALID names still work
+    seen.clear()
+    monkeypatch.setenv("EKSML_BENCH_RUNGS", " 512_b1 , 1344_b4 ")
+    bench_mod.main(["--steps", "1"])
+    assert seen == [1, 4]
+    capsys.readouterr()
+
+
 def test_point_flags_require_single():
     """Explicit operating-point flags without --single must fail fast
     (the ladder would silently override them — benching a point the
