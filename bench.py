@@ -307,7 +307,11 @@ def run_ladder(args, diag: dict) -> None:
         names = [t.strip() for t in keep.split(",") if t.strip()]
         known = {r["name"] for r in RUNGS}
         bad = [n for n in names if n not in known]
-        if bad or not names:
+        if not names:
+            raise ValueError(
+                f"EKSML_BENCH_RUNGS={keep!r} contains no rung names "
+                f"(known: {sorted(known)})")
+        if bad:
             # every requested name must resolve — a typo silently
             # dropping the headline rung must fail loudly, not bench
             # a subset the caller didn't ask for

@@ -17,13 +17,13 @@ job, TensorBoard and the notebooks to one run directory.  Helm 3 has no
 {{/*
 GKE gke-tpu-topology node label for the selected slice — the physical
 chip grid (v5e-32 = 4x8), NOT the chip count.  Map mirrors the slice
-inventory (eksml_tpu/parallel/mesh.py V5E_TOPOLOGY_GRIDS and
+inventory (eksml_tpu/parallel/mesh.py TOPOLOGY_GRIDS and
 native_src/topology.cc kSlices); tests/test_orchestration.py asserts
 the three stay in lockstep.  An invalid label here leaves every
 training pod Pending on a real nodepool.
 */}}
 {{- define "maskrcnn.topologyLabel" -}}
-{{- $grids := dict "v5e-1" "1x1" "v5e-4" "2x2" "v5e-8" "2x4" "v5e-16" "4x4" "v5e-32" "4x8" "v5e-64" "8x8" "v5e-128" "8x16" "v5e-256" "16x16" -}}
+{{- $grids := dict "v5e-1" "1x1" "v5e-4" "2x2" "v5e-8" "2x4" "v5e-16" "4x4" "v5e-32" "4x8" "v5e-64" "8x8" "v5e-128" "8x16" "v5e-256" "16x16" "v6e-1" "1x1" "v6e-4" "2x2" "v6e-8" "2x4" "v6e-16" "4x4" "v6e-32" "4x8" "v6e-64" "8x8" "v6e-128" "8x16" "v6e-256" "16x16" -}}
 {{- $label := get $grids .Values.maskrcnn.topology -}}
 {{- required (printf "unknown topology %q (valid: %s)" .Values.maskrcnn.topology (keys $grids | sortAlpha | join ", ")) $label -}}
 {{- end -}}
@@ -36,7 +36,7 @@ chips stays the TOTAL across slices, so hosts must divide evenly.
 {{- define "maskrcnn.hostsPerSlice" -}}
 {{- $hosts := include "maskrcnn.hosts" . | int -}}
 {{- $slices := int (.Values.maskrcnn.num_slices | default 1) -}}
-{{- $sliceChips := trimPrefix "v5e-" .Values.maskrcnn.topology | int -}}
+{{- $sliceChips := regexReplaceAll "^v[0-9]+e-" .Values.maskrcnn.topology "" | int -}}
 {{- if ne (int .Values.maskrcnn.chips) (mul $sliceChips $slices) -}}
 {{- fail (printf "chips (%d) must equal topology chips (%d) x num_slices (%d) — chips is the TOTAL across slices" (int .Values.maskrcnn.chips) $sliceChips $slices) -}}
 {{- end -}}

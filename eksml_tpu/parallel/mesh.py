@@ -55,15 +55,30 @@ V5E_TOPOLOGY_GRIDS = {
     "v5e-256": (16, 16),
 }
 
+# v6e (Trillium) slice inventory: same 2D-torus slice shapes and
+# 4-chip hosts as v5e (machine type ct6e-standard-4t — the terraform
+# tpu_machine_type for a v6e pool), ~4.7x the bf16 peak per chip
+# (bench.py PEAK_FLOPS).  Topology names follow the same
+# ``cloud.google.com/gke-tpu-topology`` label scheme.
+V6E_TOPOLOGIES = {name.replace("v5e-", "v6e-"): ch
+                  for name, ch in V5E_TOPOLOGIES.items()}
+V6E_TOPOLOGY_GRIDS = {name.replace("v5e-", "v6e-"): grid
+                      for name, grid in V5E_TOPOLOGY_GRIDS.items()}
+
+# canonical inventory across generations — validate_topology,
+# topology_label, the chart enum and the C++ shim all track THIS
+TOPOLOGIES = {**V5E_TOPOLOGIES, **V6E_TOPOLOGIES}
+TOPOLOGY_GRIDS = {**V5E_TOPOLOGY_GRIDS, **V6E_TOPOLOGY_GRIDS}
+
 
 def topology_label(topology: str) -> str:
     """GKE ``gke-tpu-topology`` node-label string for a slice name
     (``v5e-32`` → ``"4x8"``)."""
-    if topology not in V5E_TOPOLOGY_GRIDS:
+    if topology not in TOPOLOGY_GRIDS:
         raise ValueError(
             f"unknown TPU topology {topology!r}; valid: "
-            f"{sorted(V5E_TOPOLOGY_GRIDS)}")
-    x, y = V5E_TOPOLOGY_GRIDS[topology]
+            f"{sorted(TOPOLOGY_GRIDS)}")
+    x, y = TOPOLOGY_GRIDS[topology]
     return f"{x}x{y}"
 
 
@@ -82,11 +97,11 @@ def validate_topology(topology: str = "", num_chips: Optional[int] = None,
     if num_slices < 1:
         raise ValueError(f"num_slices={num_slices} must be >= 1")
     if topology:
-        if topology not in V5E_TOPOLOGIES:
+        if topology not in TOPOLOGIES:
             raise ValueError(
                 f"unknown TPU topology {topology!r}; valid: "
-                f"{sorted(V5E_TOPOLOGIES)}")
-        chips, hosts = V5E_TOPOLOGIES[topology]
+                f"{sorted(TOPOLOGIES)}")
+        chips, hosts = TOPOLOGIES[topology]
         chips, hosts = chips * num_slices, hosts * num_slices
         if num_chips not in (None, chips):
             raise ValueError(
@@ -99,7 +114,7 @@ def validate_topology(topology: str = "", num_chips: Optional[int] = None,
         num_chips % chips_per_host == 0 and num_chips > 0)
     if not valid:
         raise ValueError(
-            f"num_chips={num_chips} is not a valid v5e slice: need 1, 2, "
+            f"num_chips={num_chips} is not a valid slice: need 1, 2, "
             f"or a multiple of chips_per_host={chips_per_host}")
     hosts = max(1, num_chips // chips_per_host)
     return num_chips, hosts

@@ -73,13 +73,13 @@ def host_ring(name: str) -> Optional[List[int]]:
 
 
 def _host_ring_py(name: str) -> Optional[List[int]]:
-    from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES
+    from eksml_tpu.parallel.mesh import TOPOLOGIES, TOPOLOGY_GRIDS
 
-    if name not in V5E_TOPOLOGIES:
+    if name not in TOPOLOGIES:
         return None
-    chips, hosts = V5E_TOPOLOGIES[name]
-    grid = {1: 1, 4: 2, 8: 2, 16: 4, 32: 4, 64: 8, 128: 8, 256: 16}
-    hx = max(grid.get(chips, 1) // 2, 1)
+    _, hosts = TOPOLOGIES[name]
+    # host grid: hosts tile the chip grid 2 columns (of chips) wide
+    hx = max(TOPOLOGY_GRIDS[name][0] // 2, 1)
     hy = max(hosts // hx, 1)
     order = []
     for row in range(hy):

@@ -24,13 +24,20 @@ struct V5eSlice {
   int32_t mesh_y;
 };
 
-// v5e slice inventory (chips = hosts × 4 above 4 chips); the physical
-// grid determines ICI neighbor distance.
+// v5e + v6e (Trillium) slice inventories (chips = hosts × 4 above 4
+// chips; v6e's ct6e-standard-4t hosts carry 4 chips like v5e's); the
+// physical grid determines ICI neighbor distance.  Mirrors
+// mesh.py TOPOLOGIES / TOPOLOGY_GRIDS (tests/test_native_topology.py
+// asserts the two inventories agree name-for-name).
 constexpr V5eSlice kSlices[] = {
     {"v5e-1", 1, 1, 1, 1},     {"v5e-4", 4, 1, 2, 2},
     {"v5e-8", 8, 2, 2, 4},     {"v5e-16", 16, 4, 4, 4},
     {"v5e-32", 32, 8, 4, 8},   {"v5e-64", 64, 16, 8, 8},
     {"v5e-128", 128, 32, 8, 16}, {"v5e-256", 256, 64, 16, 16},
+    {"v6e-1", 1, 1, 1, 1},     {"v6e-4", 4, 1, 2, 2},
+    {"v6e-8", 8, 2, 2, 4},     {"v6e-16", 16, 4, 4, 4},
+    {"v6e-32", 32, 8, 4, 8},   {"v6e-64", 64, 16, 8, 8},
+    {"v6e-128", 128, 32, 8, 16}, {"v6e-256", 256, 64, 16, 16},
 };
 constexpr int kNumSlices = sizeof(kSlices) / sizeof(kSlices[0]);
 

@@ -41,8 +41,9 @@ variable "release_channel" {
 # ≙ node_instance_type default p3.16xlarge (8×V100); ct5lp-hightpu-4t is
 # the v5e host machine (4 chips)
 variable "tpu_machine_type" {
-  type    = string
-  default = "ct5lp-hightpu-4t"
+  description = "TPU host machine type: ct5lp-hightpu-4t (v5e) or ct6e-standard-4t (v6e/Trillium); pair with the matching v5e-*/v6e-* chart topology"
+  type        = string
+  default     = "ct5lp-hightpu-4t"
 }
 
 # slice topology label (physical chip grid, per the slice inventory

@@ -71,8 +71,9 @@ variable "pool_name" {
   default = "tpu-v5e"
 }
 variable "tpu_machine_type" {
-  type    = string
-  default = "ct5lp-hightpu-4t"
+  description = "TPU host machine type: ct5lp-hightpu-4t (v5e) or ct6e-standard-4t (v6e/Trillium); pair with the matching v5e-*/v6e-* chart topology"
+  type        = string
+  default     = "ct5lp-hightpu-4t"
 }
 # physical chip grid label (v5e-32 = 4x8, per the slice inventory in
 # eksml_tpu/parallel/mesh.py V5E_TOPOLOGY_GRIDS)

@@ -7,7 +7,7 @@ between its CRD schema and the operator's --gpus-per-node arithmetic.
 import numpy as np
 import pytest
 
-from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES, validate_topology
+from eksml_tpu.parallel.mesh import TOPOLOGIES, validate_topology
 from eksml_tpu.parallel.native import (get_lib, host_ring,
                                        recommend_combine_threshold,
                                        topo_lookup)
@@ -17,18 +17,18 @@ def test_native_lib_builds():
     assert get_lib() is not None, "C++ topology shim failed to build"
 
 
-@pytest.mark.parametrize("name", sorted(V5E_TOPOLOGIES))
+@pytest.mark.parametrize("name", sorted(TOPOLOGIES))
 def test_lookup_agrees_with_python_inventory(name):
-    from eksml_tpu.parallel.mesh import V5E_TOPOLOGY_GRIDS, topology_label
+    from eksml_tpu.parallel.mesh import TOPOLOGY_GRIDS, topology_label
 
     info = topo_lookup(name)
     assert info is not None
     chips, hosts, mx, my = info
-    assert (chips, hosts) == V5E_TOPOLOGIES[name]
+    assert (chips, hosts) == TOPOLOGIES[name]
     assert mx * my == chips  # physical grid covers the slice
     # grid (and thus the gke-tpu-topology label) agrees across the
     # C++ and python inventories
-    assert (mx, my) == V5E_TOPOLOGY_GRIDS[name]
+    assert (mx, my) == TOPOLOGY_GRIDS[name]
     assert topology_label(name) == f"{mx}x{my}"
 
 
@@ -36,9 +36,9 @@ def test_lookup_unknown():
     assert topo_lookup("v5e-7") is None
 
 
-@pytest.mark.parametrize("name", sorted(V5E_TOPOLOGIES))
+@pytest.mark.parametrize("name", sorted(TOPOLOGIES))
 def test_host_ring_is_permutation(name):
-    _, hosts = V5E_TOPOLOGIES[name]
+    _, hosts = TOPOLOGIES[name]
     ring = host_ring(name)
     assert sorted(ring) == list(range(hosts))
 

@@ -144,15 +144,15 @@ def test_chart_variants_share_template():
 
 def test_schema_topology_enum_matches_runtime_inventory():
     """The schema's topology enum, its chips enum, and its cross-field
-    if/then pairs must all track V5E_TOPOLOGIES in mesh.py — drift
+    if/then pairs must all track TOPOLOGIES in mesh.py — drift
     between the helm-time and runtime validators would let installs
     pass that the trainer then rejects (or vice versa)."""
-    from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES
+    from eksml_tpu.parallel.mesh import TOPOLOGIES
 
     schema = json.loads(_read("charts/maskrcnn/values.schema.json"))
     m = schema["properties"]["maskrcnn"]
     topo_enum = set(m["properties"]["topology"]["enum"])
-    assert topo_enum == set(V5E_TOPOLOGIES)
+    assert topo_enum == set(TOPOLOGIES)
     # chips is a free positive integer at the property level (the
     # multislice TOTAL can be any product); exactness comes from the
     # single-slice if/then pins plus the render-time product check in
@@ -172,8 +172,8 @@ def test_schema_topology_enum_matches_runtime_inventory():
         then = clause["then"]["properties"]
         pinned[topo] = (then["chips"]["const"],
                         then["chips_per_host"]["const"])
-    assert set(pinned) == set(V5E_TOPOLOGIES)
-    for topo, (chips, hosts) in V5E_TOPOLOGIES.items():
+    assert set(pinned) == set(TOPOLOGIES)
+    for topo, (chips, hosts) in TOPOLOGIES.items():
         want_cph = 1 if hosts == 1 and chips == 1 else 4
         assert pinned[topo] == (chips, want_cph), topo
 
@@ -217,10 +217,10 @@ def test_optimized_extra_config_round_trips_through_config():
 
 
 def test_jobset_chart_topologies_match_runtime_inventory():
-    from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES
+    from eksml_tpu.parallel.mesh import TOPOLOGIES
 
     vals = yaml.safe_load(_read("charts/jobset/values.yaml"))
-    assert set(vals["topologies"]) == set(V5E_TOPOLOGIES)
+    assert set(vals["topologies"]) == set(TOPOLOGIES)
 
 
 # ---- gke-tpu-topology node label pipeline ---------------------------
@@ -245,7 +245,7 @@ def _helper_topology_map(chart):
 @pytest.mark.parametrize("chart", ["charts/maskrcnn",
                                    "charts/maskrcnn-optimized"])
 def test_rendered_topology_nodeselector_is_valid_gke_label(chart):
-    from eksml_tpu.parallel.mesh import (V5E_TOPOLOGY_GRIDS,
+    from eksml_tpu.parallel.mesh import (TOPOLOGY_GRIDS,
                                          topology_label)
 
     # the nodeSelector must come from the helper, not ad-hoc string
@@ -259,11 +259,11 @@ def test_rendered_topology_nodeselector_is_valid_gke_label(chart):
     # the helper map covers every inventory slice with its grid label
     labels = _helper_topology_map(chart)
     assert labels == {name: topology_label(name)
-                      for name in V5E_TOPOLOGY_GRIDS}
+                      for name in TOPOLOGY_GRIDS}
     # grid labels are grids, not chip counts ("32x1"-style)
     for name, label in labels.items():
         x, y = map(int, label.split("x"))
-        chips = V5E_TOPOLOGY_GRIDS[name][0] * V5E_TOPOLOGY_GRIDS[name][1]
+        chips = TOPOLOGY_GRIDS[name][0] * TOPOLOGY_GRIDS[name][1]
         assert x * y == chips and x <= y, (name, label)
 
 
@@ -403,7 +403,9 @@ def test_multislice_chart_plumbing(chart):
     assert "maskrcnn.hostsPerSlice" in helpers
     assert "fail" in helpers  # hosts % num_slices enforced at render
     # chips-is-TOTAL enforced at render: chips == slice_chips x slices
-    assert 'trimPrefix "v5e-"' in helpers and "mul $sliceChips" in helpers
+    # (generation-agnostic prefix strip so v6e names resolve too)
+    assert 'regexReplaceAll "^v[0-9]+e-"' in helpers \
+        and "mul $sliceChips" in helpers
 
 
 def test_multislice_rank_composition():

@@ -10,7 +10,7 @@ from eksml_tpu.parallel import (batch_sharding, build_mesh, cross_host_sum,
                                 param_fingerprint, replicated_sharding,
                                 validate_topology)
 from eksml_tpu.parallel.collectives import assert_replicas_in_sync
-from eksml_tpu.parallel.mesh import V5E_TOPOLOGIES
+from eksml_tpu.parallel.mesh import TOPOLOGIES
 
 
 def test_validate_topology_names():
@@ -107,9 +107,25 @@ def test_replica_sync_check():
 
 
 def test_v5e_inventory_consistent():
-    for name, (chips, hosts) in V5E_TOPOLOGIES.items():
+    for name, (chips, hosts) in TOPOLOGIES.items():
         assert chips == int(name.split("-")[1])
         assert chips == hosts * 4 or chips < 4
+
+
+def test_v6e_generation_supported_end_to_end():
+    """v6e (Trillium) slices validate, label, and compose Multislice
+    the same way v5e does — both generations use 4-chip hosts and the
+    same 2D-torus grids (machine type is the only infra difference)."""
+    from eksml_tpu.parallel.mesh import topology_label, validate_topology
+
+    assert validate_topology("v6e-32") == (32, 8)
+    assert topology_label("v6e-32") == "4x8"
+    assert validate_topology("v6e-16", num_slices=2) == (32, 8)
+    # both generations present and chip-for-chip symmetric
+    v5e = {n for n in TOPOLOGIES if n.startswith("v5e-")}
+    v6e = {n for n in TOPOLOGIES if n.startswith("v6e-")}
+    assert {n.replace("v5e-", "") for n in v5e} == \
+        {n.replace("v6e-", "") for n in v6e}
 
 
 # ---- multi-slice (DCN) mesh --------------------------------------------
