@@ -68,6 +68,16 @@ LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "artifacts", "bench_last_good.json")
 
 
+def is_hardware(diag: dict, key: str = "device_kind") -> bool:
+    """THE hardware-evidence gate (single definition for the Python
+    side; the shell heredocs in tools/ mirror it): a measurement may
+    only be banked as hardware evidence when its device field names a
+    real accelerator.  Tolerates explicit null device fields (a run
+    that died before device init)."""
+    return ((diag or {}).get(key) or "").lower() not in ("", "cpu",
+                                                         "host")
+
+
 def _bank(path: str, diag: dict) -> None:
     """Persist a successful result (timestamped) so a later
     wedged-tunnel run can still cite real hardware evidence (VERDICT r2
@@ -371,8 +381,7 @@ def run_ladder(args, diag: dict) -> None:
                 "value", "step_time_ms", "mfu", "remat_fallback")}})
         # hardware evidence only (same rule as _bank_last_good): a CPU
         # smoke of the ladder must not clobber banked TPU rung files
-        if rdiag.get("device_kind", "").lower() not in ("", "cpu",
-                                                        "host"):
+        if is_hardware(rdiag):
             _bank(os.path.join(os.path.dirname(LAST_GOOD),
                                f"bench_rung_{rung['name']}.json"),
                   rdiag)
@@ -525,7 +534,7 @@ def run(args, diag: dict) -> None:
     # bank HARDWARE evidence only: a CPU smoke overwriting the banked
     # TPU number would defeat the feature (the stale record a failure
     # cites must be a real accelerator measurement)
-    if diag["value"] > 0 and dev_kind.lower() not in ("cpu", "host"):
+    if diag["value"] > 0 and is_hardware(diag):
         _bank_last_good(diag)
 
 

@@ -33,3 +33,63 @@ def test_ledger_pins_history():
     assert by_round[1]["bench_imgs_per_sec_chip"] in (None, 0.0)
     assert by_round[2]["convergence_bbox_ap50"] == 0.2136
     assert by_round[2]["suite_passed"] == 166
+
+
+def test_bank_round_collect_is_hardware_gated(tmp_path, monkeypatch):
+    """bank_round.collect must take bench/rung/A-B numbers only from
+    hardware-labeled artifacts and fall back to the previous round's
+    convergence artifact for the AP column."""
+    import json
+
+    import tools.bank_round as br
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    monkeypatch.setattr(br, "REPO", str(tmp_path))
+    # CPU ladder line must NOT become the round's bench number
+    (tmp_path / "BENCH_LOCAL.json").write_text(json.dumps(
+        {"value": 5.0, "device_kind": "cpu"}))
+    (art / "bench_last_good.json").write_text(json.dumps(
+        {"value": 21.5, "mfu": 0.31, "device_kind": "TPU v5 lite",
+         "operating_point": "1344_b4"}))
+    (art / "bench_rung_512_b1.json").write_text(json.dumps(
+        {"value": 40.0, "mfu": 0.1, "device_kind": "TPU v5 lite",
+         "operating_point": "512_b1"}))
+    (art / "roi_ab_r4.json").write_text(json.dumps({"runs": [
+        {"run": "roi_ab_pallas_512", "value": 30.0,
+         "device_kind": "TPU v5 lite"},
+        {"run": "roi_ab_xla_512", "value": 10.0,
+         "device_kind": "TPU v5 lite"},
+        {"run": "roi_ab_pallas_1344", "value": 9.0,
+         "device_kind": "cpu"},  # CPU row: excluded
+    ]}))
+    (art / "convergence_r3.json").write_text(json.dumps(
+        {"bbox_AP50": 0.53, "device": "cpu"}))
+
+    facts = br.collect(4)
+    assert facts["bench"] == 21.5 and facts["mfu"] == 0.31
+    assert facts["bench_point"] == "1344_b4"
+    assert facts["rungs"] == {"512_b1": {"value": 40.0, "mfu": 0.1}}
+    assert facts["ab"]["runs_banked"] == 2
+    assert facts["ab"]["speedup_512"] == 3.0
+    assert facts["convergence_ap50"] == 0.53
+    assert facts["convergence_round"] == 3
+
+
+def test_bank_round_tolerates_null_device_rows(tmp_path, monkeypatch):
+    """A merged A/B row from a run that died before device init
+    carries device_kind: null — collect must skip it, not crash."""
+    import json
+
+    import tools.bank_round as br
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    monkeypatch.setattr(br, "REPO", str(tmp_path))
+    (art / "roi_ab_r4.json").write_text(json.dumps({"runs": [
+        {"run": "roi_ab_pallas_512", "value": None,
+         "device_kind": None, "error": "TimeoutError: tunnel hang"},
+    ]}))
+    facts = br.collect(4)
+    assert facts["ab"] == {"runs_banked": 0}
+    assert facts["convergence_round"] is None  # stable shape

@@ -1,0 +1,139 @@
+"""Round-end evidence banking: collect whatever the session's
+harvest landed and append the ledger row.
+
+Reads (all optional — absent files mean PENDING):
+  BENCH_LOCAL.json                  ladder result banked by the loop
+  artifacts/bench_last_good.json    most recent hardware bench
+  artifacts/bench_rung_*.json       per-operating-point rungs
+  artifacts/roi_ab_r{N}.json        Pallas/XLA A/B merge
+  artifacts/convergence_r{N}.json   convergence artifact
+  artifacts/convergence_r{N-1}.json fallback for the ledger AP column
+
+Appends one `tools/ledger.py` row for --round and prints a summary the
+round notes can cite.  Never overwrites artifacts; hardware-only
+numbers are taken at face value from their device fields.
+
+Usage: python tools/bank_round.py --round 4 --suite-passed 233 \
+          [--note "..."] [--dry-run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+from bench import is_hardware
+
+
+def _is_hw(d, key="device_kind"):
+    return is_hardware(d or {}, key)
+
+
+def collect(round_num: int) -> dict:
+    art = os.path.join(REPO, "artifacts")
+    out = {"round": round_num, "bench": None, "mfu": None,
+           "bench_point": None, "rungs": {}, "ab": None,
+           "convergence_ap50": None, "convergence_device": None,
+           "convergence_round": None}
+
+    # best bench: BENCH_LOCAL (loop-banked) else last_good
+    for p in (os.path.join(REPO, "BENCH_LOCAL.json"),
+              os.path.join(art, "bench_last_good.json")):
+        d = _load(p)
+        if d and d.get("value", 0) > 0 and _is_hw(d):
+            out["bench"] = d["value"]
+            out["mfu"] = d.get("mfu")
+            out["bench_point"] = d.get("operating_point",
+                                       "single-point")
+            break
+    for p in sorted(glob.glob(os.path.join(art, "bench_rung_*.json"))):
+        d = _load(p)
+        if d and _is_hw(d):
+            out["rungs"][d.get("operating_point",
+                               os.path.basename(p))] = {
+                "value": d.get("value"), "mfu": d.get("mfu")}
+
+    ab = _load(os.path.join(art, f"roi_ab_r{round_num}.json"))
+    if ab and ab.get("runs"):
+        hw = [r for r in ab["runs"] if not r.get("error") and _is_hw(r)]
+        out["ab"] = {"runs_banked": len(hw)}
+        # headline speedup at the cheapest matched pair
+        by = {r["run"]: r for r in hw}
+        for pallas, xla in (("roi_ab_pallas_512", "roi_ab_xla_512"),
+                            ("roi_ab_pallas_1344", "roi_ab_xla_1344")):
+            if pallas in by and xla in by and by[xla].get("value"):
+                out["ab"][f"speedup_{pallas.rsplit('_', 1)[-1]}"] = \
+                    round(by[pallas]["value"] / by[xla]["value"], 3)
+
+    for r in (round_num, round_num - 1):
+        d = _load(os.path.join(art, f"convergence_r{r}.json"))
+        if d:
+            out["convergence_ap50"] = d.get("bbox_AP50")
+            out["convergence_device"] = d.get("device")
+            out["convergence_round"] = r
+            break
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int, required=True)
+    p.add_argument("--suite-passed", type=int, default=None)
+    p.add_argument("--loader-imgs-per-sec", type=float, default=None)
+    p.add_argument("--note", default="")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    facts = collect(args.round)
+    print(json.dumps(facts, indent=1))
+
+    if args.dry_run:
+        return facts
+
+    from tools.ledger import append
+
+    note = args.note
+    if not note:
+        bits = []
+        if facts["bench"]:
+            bits.append(f"bench {facts['bench']} img/s/chip "
+                        f"@{facts['bench_point']}")
+        else:
+            bits.append("tunnel never yielded a bench window")
+        if facts["rungs"]:
+            bits.append(f"{len(facts['rungs'])} ladder rungs banked")
+        if (facts.get("ab") or {}).get("runs_banked"):
+            bits.append(f"{facts['ab']['runs_banked']} A/B runs")
+        if facts["convergence_ap50"] is not None:
+            bits.append(
+                f"convergence AP50 {facts['convergence_ap50']} "
+                f"({facts['convergence_device']}, "
+                f"r{facts.get('convergence_round')})")
+        note = f"r{args.round}: " + "; ".join(bits)
+    rec = append(args.round, bench=facts["bench"], mfu=facts["mfu"],
+                 loader_imgs_per_sec=args.loader_imgs_per_sec,
+                 convergence_bbox_ap50=facts["convergence_ap50"],
+                 suite_passed=args.suite_passed, note=note)
+    print("ledger row:", json.dumps(rec))
+    return facts
+
+
+if __name__ == "__main__":
+    main()
