@@ -69,7 +69,8 @@ def test_bank_round_collect_is_hardware_gated(tmp_path, monkeypatch):
     facts = br.collect(4)
     assert facts["bench"] == 21.5 and facts["mfu"] == 0.31
     assert facts["bench_point"] == "1344_b4"
-    assert facts["rungs"] == {"512_b1": {"value": 40.0, "mfu": 0.1}}
+    assert facts["rungs"] == {"512_b1": {"value": 40.0, "mfu": 0.1,
+                                         "banked_at": None}}
     assert facts["ab"]["runs_banked"] == 2
     assert facts["ab"]["speedup_512"] == 3.0
     assert facts["convergence_ap50"] == 0.53
@@ -93,3 +94,34 @@ def test_bank_round_tolerates_null_device_rows(tmp_path, monkeypatch):
     facts = br.collect(4)
     assert facts["ab"] == {"runs_banked": 0}
     assert facts["convergence_round"] is None  # stable shape
+
+
+def test_bank_round_since_filter_excludes_stale_artifacts(tmp_path,
+                                                          monkeypatch):
+    """--since must keep a stale cross-round bench_last_good (and
+    rung files) out of the new round's row — the exact corruption
+    the r1 'tunnel UNAVAILABLE' ledger row exists to record
+    truthfully."""
+    import json
+
+    import tools.bank_round as br
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    monkeypatch.setattr(br, "REPO", str(tmp_path))
+    stale = {"value": 21.5, "mfu": 0.3, "device_kind": "TPU v5 lite",
+             "operating_point": "1344_b4",
+             "banked_at": "2026-07-30T10:00:00Z"}
+    (art / "bench_last_good.json").write_text(json.dumps(stale))
+    (art / "bench_rung_512_b1.json").write_text(json.dumps(
+        {**stale, "operating_point": "512_b1"}))
+
+    cutoff = "2026-07-31T00:00:00Z"
+    facts = br.collect(5, since=cutoff)
+    assert facts["bench"] is None and facts["rungs"] == {}
+
+    fresh = {**stale, "banked_at": "2026-07-31T12:00:00Z"}
+    (art / "bench_last_good.json").write_text(json.dumps(fresh))
+    facts = br.collect(5, since=cutoff)
+    assert facts["bench"] == 21.5
+    assert facts["bench_banked_at"] == "2026-07-31T12:00:00Z"

@@ -1,0 +1,101 @@
+"""Property-based tests (hypothesis) for the geometric ops.
+
+The unit tests pin specific values; these pin the *invariants* that
+must hold for every input — the class of bug (a degenerate box, an
+extreme aspect ratio, a coordinate at the canvas edge) that example
+tests historically miss and that, on TPU, surfaces as a silent AP
+drop rather than a crash.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from eksml_tpu.ops.boxes import (clip_boxes, decode_boxes, encode_boxes,
+                                 flip_boxes_horizontal, pairwise_iou)
+from eksml_tpu.ops.nms import nms_mask
+
+# well-formed xyxy boxes inside a 0..200 canvas, nonzero size
+_coord = st.floats(0.0, 199.0, allow_nan=False, width=32)
+_size = st.floats(0.5, 120.0, allow_nan=False, width=32)
+
+
+@st.composite
+def boxes(draw, n_min=1, n_max=8):
+    n = draw(st.integers(n_min, n_max))
+    out = []
+    for _ in range(n):
+        x1, y1 = draw(_coord), draw(_coord)
+        w, h = draw(_size), draw(_size)
+        out.append([x1, y1, min(x1 + w, 200.0), min(y1 + h, 200.0)])
+    return np.asarray(out, np.float32)
+
+
+@settings(max_examples=50, deadline=None)
+@given(boxes(), boxes())
+def test_iou_bounds_and_symmetry(a, b):
+    iou = np.asarray(pairwise_iou(jnp.asarray(a), jnp.asarray(b)))
+    assert np.all(iou >= -1e-6) and np.all(iou <= 1.0 + 1e-6)
+    iou_t = np.asarray(pairwise_iou(jnp.asarray(b), jnp.asarray(a)))
+    np.testing.assert_allclose(iou, iou_t.T, atol=1e-5)
+    # self-IoU of a well-formed box is 1
+    self_iou = np.asarray(pairwise_iou(jnp.asarray(a), jnp.asarray(a)))
+    np.testing.assert_allclose(np.diag(self_iou), 1.0, atol=1e-5)
+
+
+@settings(max_examples=50, deadline=None)
+@given(boxes())
+def test_encode_decode_roundtrip(bs):
+    """decode(encode(boxes, anchors), anchors) == boxes — the contract
+    RPN/FRCNN training depends on (targets are encodings the head must
+    be able to invert)."""
+    rng = np.random.RandomState(0)
+    anchors = bs + rng.uniform(-3, 3, bs.shape).astype(np.float32)
+    anchors = np.array(clip_boxes(jnp.asarray(anchors), 220, 220))
+    # keep anchors well-formed (decode divides by anchor w/h)
+    anchors[:, 2] = np.maximum(anchors[:, 2], anchors[:, 0] + 0.5)
+    anchors[:, 3] = np.maximum(anchors[:, 3], anchors[:, 1] + 0.5)
+    deltas = encode_boxes(jnp.asarray(bs), jnp.asarray(anchors))
+    back = np.asarray(decode_boxes(deltas, jnp.asarray(anchors)))
+    np.testing.assert_allclose(back, bs, atol=1e-2)
+
+
+@settings(max_examples=50, deadline=None)
+@given(boxes(), st.floats(0.1, 0.9))
+def test_flip_is_involution_and_clip_idempotent(bs, frac):
+    w = 200.0
+    flipped2 = np.asarray(flip_boxes_horizontal(
+        flip_boxes_horizontal(jnp.asarray(bs), w), w))
+    np.testing.assert_allclose(flipped2, bs, atol=1e-4)
+    h = w_clip = 200.0 * frac
+    once = clip_boxes(jnp.asarray(bs), h, w_clip)
+    twice = np.asarray(clip_boxes(once, h, w_clip))
+    np.testing.assert_allclose(twice, np.asarray(once), atol=0)
+    assert np.all(np.asarray(once)[:, [0, 2]] <= w_clip + 1e-6)
+    assert np.all(np.asarray(once)[:, [1, 3]] <= h + 1e-6)
+
+
+@settings(max_examples=30, deadline=None)
+@given(boxes(n_min=2, n_max=10),
+       st.floats(0.2, 0.8))
+def test_nms_keep_set_is_valid(bs, thresh):
+    """NMS invariants: kept boxes are mutually below the IoU
+    threshold; every suppressed box overlaps some higher-scoring kept
+    box above it (no box is dropped for free)."""
+    n = len(bs)
+    rng = np.random.RandomState(1)
+    scores = rng.uniform(0.1, 1.0, n).astype(np.float32)
+    keep = np.asarray(nms_mask(jnp.asarray(bs), jnp.asarray(scores),
+                               thresh)).astype(bool)
+    assert keep.any()  # the top-scoring box always survives
+    iou = np.asarray(pairwise_iou(jnp.asarray(bs), jnp.asarray(bs)))
+    kept = np.where(keep)[0]
+    for i in kept:
+        for j in kept:
+            if i != j:
+                assert iou[i, j] <= thresh + 1e-5, (i, j, iou[i, j])
+    for i in np.where(~keep)[0]:
+        higher = [j for j in kept if scores[j] > scores[i]
+                  or (scores[j] == scores[i] and j < i)]
+        assert any(iou[i, j] > thresh - 1e-5 for j in higher), i

@@ -42,45 +42,61 @@ def _load(path):
 from bench import is_hardware
 
 
-def _is_hw(d, key="device_kind"):
-    return is_hardware(d or {}, key)
+def _fresh(d, since: str | None) -> bool:
+    """True when the artifact was banked at/after ``since`` (ISO-8601
+    strings compare lexicographically).  Artifacts without a banked_at
+    are rejected under a --since filter: a stale cross-round number
+    silently becoming THIS round's ledger row is the corruption this
+    guard exists for (bench.py marks such carries 'stale')."""
+    if since is None:
+        return True
+    return (d.get("banked_at") or "") >= since
 
 
-def collect(round_num: int) -> dict:
+def collect(round_num: int, since: str | None = None) -> dict:
     art = os.path.join(REPO, "artifacts")
     out = {"round": round_num, "bench": None, "mfu": None,
-           "bench_point": None, "rungs": {}, "ab": None,
-           "convergence_ap50": None, "convergence_device": None,
-           "convergence_round": None}
+           "bench_point": None, "bench_banked_at": None, "rungs": {},
+           "ab": None, "convergence_ap50": None,
+           "convergence_device": None, "convergence_round": None}
 
-    # best bench: BENCH_LOCAL (loop-banked) else last_good
-    for p in (os.path.join(REPO, "BENCH_LOCAL.json"),
-              os.path.join(art, "bench_last_good.json")):
+    # best bench: BENCH_LOCAL (loop-banked, session-scoped — the
+    # session deletes it at start, so no cross-round staleness) else
+    # last_good (timestamped; subject to --since)
+    for p, filtered in ((os.path.join(REPO, "BENCH_LOCAL.json"), False),
+                        (os.path.join(art, "bench_last_good.json"),
+                         True)):
         d = _load(p)
-        if d and d.get("value", 0) > 0 and _is_hw(d):
+        if (d and (d.get("value") or 0) > 0 and is_hardware(d)
+                and (not filtered or _fresh(d, since))):
             out["bench"] = d["value"]
             out["mfu"] = d.get("mfu")
             out["bench_point"] = d.get("operating_point",
                                        "single-point")
+            out["bench_banked_at"] = d.get("banked_at")
             break
     for p in sorted(glob.glob(os.path.join(art, "bench_rung_*.json"))):
         d = _load(p)
-        if d and _is_hw(d):
+        if d and is_hardware(d) and _fresh(d, since):
             out["rungs"][d.get("operating_point",
                                os.path.basename(p))] = {
-                "value": d.get("value"), "mfu": d.get("mfu")}
+                "value": d.get("value"), "mfu": d.get("mfu"),
+                "banked_at": d.get("banked_at")}
 
     ab = _load(os.path.join(art, f"roi_ab_r{round_num}.json"))
     if ab and ab.get("runs"):
-        hw = [r for r in ab["runs"] if not r.get("error") and _is_hw(r)]
+        hw = [r for r in ab["runs"]
+              if not r.get("error") and is_hardware(r)]
         out["ab"] = {"runs_banked": len(hw)}
-        # headline speedup at the cheapest matched pair
         by = {r["run"]: r for r in hw}
-        for pallas, xla in (("roi_ab_pallas_512", "roi_ab_xla_512"),
-                            ("roi_ab_pallas_1344", "roi_ab_xla_1344")):
+        for pallas, xla in (
+                ("roi_ab_pallas_512", "roi_ab_xla_512"),
+                ("roi_ab_pallas_832x1344", "roi_ab_xla_832x1344"),
+                ("roi_ab_pallas_1344", "roi_ab_xla_1344")):
             if pallas in by and xla in by and by[xla].get("value"):
                 out["ab"][f"speedup_{pallas.rsplit('_', 1)[-1]}"] = \
-                    round(by[pallas]["value"] / by[xla]["value"], 3)
+                    round((by[pallas].get("value") or 0)
+                          / by[xla]["value"], 3)
 
     for r in (round_num, round_num - 1):
         d = _load(os.path.join(art, f"convergence_r{r}.json"))
@@ -97,11 +113,16 @@ def main(argv=None):
     p.add_argument("--round", type=int, required=True)
     p.add_argument("--suite-passed", type=int, default=None)
     p.add_argument("--loader-imgs-per-sec", type=float, default=None)
+    p.add_argument("--since", default=None,
+                   help="ISO-8601 UTC cutoff: only bank timestamped "
+                        "artifacts banked at/after this (pass the "
+                        "round's start time to exclude stale "
+                        "cross-round numbers)")
     p.add_argument("--note", default="")
     p.add_argument("--dry-run", action="store_true")
     args = p.parse_args(argv)
 
-    facts = collect(args.round)
+    facts = collect(args.round, since=args.since)
     print(json.dumps(facts, indent=1))
 
     if args.dry_run:
@@ -113,8 +134,10 @@ def main(argv=None):
     if not note:
         bits = []
         if facts["bench"]:
+            when = (f" banked {facts['bench_banked_at']}"
+                    if facts.get("bench_banked_at") else "")
             bits.append(f"bench {facts['bench']} img/s/chip "
-                        f"@{facts['bench_point']}")
+                        f"@{facts['bench_point']}{when}")
         else:
             bits.append("tunnel never yielded a bench window")
         if facts["rungs"]:
