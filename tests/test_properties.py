@@ -99,3 +99,36 @@ def test_nms_keep_set_is_valid(bs, thresh):
         higher = [j for j in kept if scores[j] > scores[i]
                   or (scores[j] == scores[i] and j < i)]
         assert any(iou[i, j] > thresh - 1e-5 for j in higher), i
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.integers(60, 1400), st.integers(60, 1400),
+       st.integers(500, 900))
+def test_bucket_assignment_always_fits(h, w, short_edge):
+    """Every source shape must land in a bucket its resized image
+    actually fits (or the largest bucket via force-fit), and
+    resize_and_pad into that bucket must fill the exact canvas with
+    the image flush at the top-left — a mis-assignment here is a
+    silent truncation, not a crash."""
+    from eksml_tpu.data.loader import (assign_bucket, resize_and_pad)
+
+    max_size = 1344
+    buckets = [(832, 1344), (1344, 832), (1344, 1344)]
+    buckets = sorted(buckets, key=lambda b: b[0] * b[1])
+    idx = assign_bucket(h, w, short_edge, max_size, buckets)
+    bh, bw = buckets[idx]
+    img = np.zeros((h, w, 3), np.float32)
+    out, scale, (nh, nw) = resize_and_pad(img, short_edge, max_size,
+                                          pad_hw=(bh, bw))
+    assert out.shape == (bh, bw, 3)
+    assert 0 < scale  # force-fit may shrink further but never flips sign
+    assert 0 < nh <= bh and 0 < nw <= bw
+    if idx < len(buckets) - 1:
+        # non-terminal bucket: the STANDARD resize fits — no force-fit
+        # shrink happened, so geometry matches the no-bucket path
+        from eksml_tpu.data.loader import _resized_hw
+
+        _, sh, sw = _resized_hw(h, w, short_edge, max_size)
+        assert (sh, sw) == (nh, nw)
+    # aspect ratio preserved to rounding
+    assert abs(nh / nw - h / w) < 0.05 * (h / w) + 0.02

@@ -93,7 +93,9 @@ def collect(round_num: int, since: str | None = None) -> dict:
                 ("roi_ab_pallas_512", "roi_ab_xla_512"),
                 ("roi_ab_pallas_832x1344", "roi_ab_xla_832x1344"),
                 ("roi_ab_pallas_1344", "roi_ab_xla_1344")):
-            if pallas in by and xla in by and by[xla].get("value"):
+            if (pallas in by and xla in by
+                    and by[pallas].get("value")
+                    and by[xla].get("value")):
                 out["ab"][f"speedup_{pallas.rsplit('_', 1)[-1]}"] = \
                     round((by[pallas].get("value") or 0)
                           / by[xla]["value"], 3)
