@@ -10,6 +10,12 @@ drop rather than a crash.
 import numpy as np
 from hypothesis import given, settings, strategies as st
 
+# deterministic examples: the driver's round-end suite run must not
+# flake on a fresh random draw — new examples are explored by running
+# with HYPOTHESIS_PROFILE-style overrides locally, not in CI
+settings.register_profile("eksml", derandomize=True, deadline=None)
+settings.load_profile("eksml")
+
 import jax.numpy as jnp
 
 from eksml_tpu.ops.boxes import (clip_boxes, decode_boxes, encode_boxes,
