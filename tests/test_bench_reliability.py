@@ -168,9 +168,10 @@ def test_ladder_banks_each_rung_and_promotes_headline(monkeypatch,
     monkeypatch.setattr(bench_mod, "run", fake_run)
     monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
     bench_mod.main(["--steps", "1"])
-    assert seen == [(512, (), 1), (1344, (832, 1344), 4),
+    assert seen == [(256, (), 1), (512, (), 1), (1344, (832, 1344), 4),
                     (1344, (), 4)]
-    for rung in ("512_b1", "832x1344_b4", "1344_b4"):
+    for rung in ("micro_256_b1_fwd", "512_b1", "832x1344_b4",
+                 "1344_b4"):
         banked = json.load(open(tmp_path / f"bench_rung_{rung}.json"))
         assert banked["value"] > 0 and "banked_at" in banked
     out_lines = [l for l in capsys.readouterr().out.splitlines()
@@ -179,9 +180,9 @@ def test_ladder_banks_each_rung_and_promotes_headline(monkeypatch,
     diag = json.loads(out_lines[0])
     assert diag["operating_point"] == "1344_b4"
     assert diag["headline_point"] is True
-    assert diag["value"] == 30.0
+    assert diag["value"] == 40.0
     assert [r["rung"] for r in diag["rungs"]] == [
-        "512_b1", "832x1344_b4", "1344_b4"]
+        "micro_256_b1_fwd", "512_b1", "832x1344_b4", "1344_b4"]
 
 
 def test_ladder_partial_failure_keeps_cheap_rung(monkeypatch,
@@ -262,6 +263,7 @@ def test_ladder_carries_remat_to_larger_rungs(monkeypatch, tmp_path,
     monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
     bench_mod.main(["--steps", "1"])
     assert calls == [
+        (256, False, False),    # micro rung: no remat needed
         (512, False, False),    # cheap rung: no remat needed
         (1344, True, False),    # bucket rung: OOM ...
         (1344, True, True),     # ... retried with remat
@@ -335,7 +337,7 @@ def test_ladder_total_failure_surfaces_error(monkeypatch, tmp_path,
     diag = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert diag["value"] == 0.0
     assert "backend init exceeded" in diag["error"]
-    assert diag["ladder_abort"]["rung"] == "512_b1"
+    assert diag["ladder_abort"]["rung"] == "micro_256_b1_fwd"
 
 
 def test_collective_flag_rollback_on_rejection(monkeypatch):
@@ -403,3 +405,102 @@ def test_last_good_absent_keeps_diag_clean(monkeypatch, tmp_path, capsys):
     bench_mod.main(["--steps", "1"])
     diag = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "last_good" not in diag
+
+
+def test_preflight_rejects_dead_port_fast(monkeypatch):
+    """VERDICT r4 next #7: during a dead tunnel window the bench must
+    fail in well under a second instead of paying the 180-300s init
+    deadline.  An unbound localhost port stands in for the dead
+    relay."""
+    import socket
+
+    # grab a port that is guaranteed free, then close it
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("EKSML_TUNNEL_PORT", str(port))
+    t0 = time.time()
+    with pytest.raises(ConnectionError, match="pre-flight"):
+        bench_mod._tunnel_preflight()
+    assert time.time() - t0 < 2.0
+
+
+def test_preflight_passes_on_listening_port(monkeypatch):
+    import socket
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    monkeypatch.setenv("EKSML_TUNNEL_PORT",
+                       str(srv.getsockname()[1]))
+    try:
+        bench_mod._tunnel_preflight()  # must not raise
+    finally:
+        srv.close()
+
+
+def test_preflight_applies_gating(monkeypatch):
+    """CPU smokes (the suite, --platform cpu) and the explicit skip
+    env must bypass the probe; a real-tunnel run must not."""
+    import argparse
+
+    ns = argparse.Namespace(platform=None)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.delenv("EKSML_SKIP_PREFLIGHT", raising=False)
+    for var in ("EKSML_TUNNEL_HOST", "EKSML_TUNNEL_PORT", "PROBE_PORT"):
+        monkeypatch.delenv(var, raising=False)
+    assert bench_mod._preflight_applies(ns)
+    # a direct-TPU host (no axon relay, no tunnel env) must NOT probe
+    # 127.0.0.1 — it would fail instantly forever (code review r5)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert not bench_mod._preflight_applies(ns)
+    monkeypatch.setenv("PROBE_PORT", "8103")  # explicit config: probe
+    assert bench_mod._preflight_applies(ns)
+    monkeypatch.delenv("PROBE_PORT")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("EKSML_SKIP_PREFLIGHT", "1")
+    assert not bench_mod._preflight_applies(ns)
+    monkeypatch.delenv("EKSML_SKIP_PREFLIGHT")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert not bench_mod._preflight_applies(ns)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert not bench_mod._preflight_applies(
+        argparse.Namespace(platform="cpu"))
+
+
+def test_micro_rung_is_forward_only_and_tiny(monkeypatch, tmp_path,
+                                             capsys):
+    """Rung 0 (VERDICT r4 next #1) must run forward-only with ~3 steps
+    so it banks inside a ~2-minute tunnel window, carry a distinct
+    metric name, and never ratio itself against the train-throughput
+    baseline anchor."""
+    import json
+
+    monkeypatch.setattr(bench_mod, "LAST_GOOD",
+                        str(tmp_path / "bench_last_good.json"))
+    seen = []
+
+    def fake_run(args, diag):
+        if not getattr(args, "forward_only", False):
+            raise TimeoutError("tunnel died after the micro rung")
+        seen.append((args.image_size, args.forward_only,
+                     args.steps, args.warmup))
+        diag["value"] = 7.0
+        diag["device_kind"] = "TPU v5 lite"
+
+    monkeypatch.setattr(bench_mod, "run", fake_run)
+    monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
+    bench_mod.main(["--steps", "20"])
+    assert seen == [(256, True, 3, 1)]
+    diag = json.loads(
+        [l for l in capsys.readouterr().out.splitlines()
+         if l.strip().startswith("{")][-1])
+    # the tunnel "died" AFTER the micro rung banked
+    banked = json.load(
+        open(tmp_path / "bench_rung_micro_256_b1_fwd.json"))
+    assert banked["value"] == 7.0
+    assert banked["metric"] == "maskrcnn_r50fpn_fwd_microbench"
+    assert banked["forward_only"] is True
+    assert diag["value"] == 7.0
+    assert diag["operating_point"] == "micro_256_b1_fwd"

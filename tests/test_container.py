@@ -118,3 +118,17 @@ def test_constraints_regenerate_is_stable():
     with redirect_stdout(buf):
         gc.main()
     assert buf.getvalue() == open(CONSTRAINTS).read()
+
+
+def test_constraints_extras_pinned_through_their_root():
+    """ADVICE r4: the closure walk must visit extras-bearing roots
+    BEFORE a transitive dep reaches the same package extras-less —
+    jax[tpu]'s extras-gated deps (libtpu, requests) must stay pinned
+    even when every other root that happens to pull them is removed."""
+    import tools.gen_constraints as gc
+
+    roots = [r for r in gc.ROOTS if r[0] not in ("jupyterlab",
+                                                 "libtpu")]
+    pins = gc.closure(roots)
+    assert "requests" in pins, "jax[tpu] extras dep lost by LIFO walk"
+    assert "libtpu" in pins, "jax[tpu] extras dep lost by LIFO walk"

@@ -125,3 +125,104 @@ def test_bank_round_since_filter_excludes_stale_artifacts(tmp_path,
     facts = br.collect(5, since=cutoff)
     assert facts["bench"] == 21.5
     assert facts["bench_banked_at"] == "2026-07-31T12:00:00Z"
+
+
+def test_bank_round_since_filter_applies_to_bench_local(tmp_path,
+                                                        monkeypatch):
+    """ADVICE r4 (medium): a leftover BENCH_LOCAL.json from a prior
+    round must NOT become the new round's ledger bench number when
+    --since is passed — it is subject to the same freshness filter as
+    bench_last_good.json (the loop stamps banked_at on write; an
+    unstamped file is rejected under --since)."""
+    import json
+
+    import tools.bank_round as br
+
+    (tmp_path / "artifacts").mkdir()
+    monkeypatch.setattr(br, "REPO", str(tmp_path))
+    # unstamped leftover (the pre-fix write format)
+    (tmp_path / "BENCH_LOCAL.json").write_text(json.dumps(
+        {"value": 33.0, "device_kind": "TPU v5 lite"}))
+    facts = br.collect(5, since="2026-07-31T00:00:00Z")
+    assert facts["bench"] is None
+    # stamped-fresh is accepted
+    (tmp_path / "BENCH_LOCAL.json").write_text(json.dumps(
+        {"value": 33.0, "device_kind": "TPU v5 lite",
+         "banked_at": "2026-08-01T05:00:00Z"}))
+    facts = br.collect(5, since="2026-07-31T00:00:00Z")
+    assert facts["bench"] == 33.0
+
+
+def test_bank_round_skips_zero_value_rungs(tmp_path, monkeypatch):
+    """ADVICE r4: a hardware rung artifact with value 0.0 must not be
+    reported as a banked ladder rung."""
+    import json
+
+    import tools.bank_round as br
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    monkeypatch.setattr(br, "REPO", str(tmp_path))
+    (art / "bench_rung_512_b1.json").write_text(json.dumps(
+        {"value": 0.0, "device_kind": "TPU v5 lite",
+         "operating_point": "512_b1"}))
+    facts = br.collect(5)
+    assert facts["rungs"] == {}
+
+
+def test_bank_round_excludes_forward_only_from_bench_column(
+        tmp_path, monkeypatch):
+    """Code review r5: a micro-rung (forward-only) artifact must never
+    fill the ledger's train-throughput bench/mfu columns — and must not
+    shadow a fresher real train number in bench_last_good.json."""
+    import json
+
+    import tools.bank_round as br
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    monkeypatch.setattr(br, "REPO", str(tmp_path))
+    (tmp_path / "BENCH_LOCAL.json").write_text(json.dumps(
+        {"value": 55.0, "mfu": 0.01, "device_kind": "TPU v5 lite",
+         "forward_only": True,
+         "operating_point": "micro_256_b1_fwd"}))
+    facts = br.collect(5)
+    assert facts["bench"] is None
+    (art / "bench_last_good.json").write_text(json.dumps(
+        {"value": 21.5, "mfu": 0.31, "device_kind": "TPU v5 lite",
+         "operating_point": "1344_b4"}))
+    facts = br.collect(5)
+    assert facts["bench"] == 21.5 and facts["mfu"] == 0.31
+
+
+def test_bench_local_util_check_and_stamp(tmp_path):
+    """One shared implementation of the banked_at stamp/TTL check
+    (code review r5: three drifting shell copies, errors silenced)."""
+    import json
+    import time
+
+    from tools import bench_local_util as blu
+
+    p = tmp_path / "BENCH_LOCAL.json"
+    # missing / unparseable / unstamped -> stale
+    assert not blu.is_fresh(str(p))
+    p.write_text("{not json")
+    assert not blu.is_fresh(str(p))
+    p.write_text(json.dumps({"value": 1.0}))
+    assert not blu.is_fresh(str(p))
+    # stamp writes atomically and the result is fresh
+    blu.stamp({"value": 2.0}, str(p))
+    rec = json.loads(p.read_text())
+    assert rec["value"] == 2.0 and "banked_at" in rec
+    assert blu.is_fresh(str(p))
+    # an old stamp fails the TTL
+    old = time.strftime(blu.FMT, time.gmtime(time.time() - 9000))
+    p.write_text(json.dumps({"value": 3.0, "banked_at": old}))
+    assert not blu.is_fresh(str(p))
+    # CLI surface the shell scripts call
+    assert blu.main(["check", "--path", str(p)]) == 1
+    assert blu.main(["stamp", "--out", str(p),
+                     json.dumps({"value": 4.0})]) == 0
+    assert blu.main(["check", "--path", str(p)]) == 0
+    assert blu.main(["stamp", "--out", str(p),
+                     "--from-file", str(p)]) == 0

@@ -60,15 +60,21 @@ def collect(round_num: int, since: str | None = None) -> dict:
            "ab": None, "convergence_ap50": None,
            "convergence_device": None, "convergence_round": None}
 
-    # best bench: BENCH_LOCAL (loop-banked, session-scoped — the
-    # session deletes it at start, so no cross-round staleness) else
-    # last_good (timestamped; subject to --since)
-    for p, filtered in ((os.path.join(REPO, "BENCH_LOCAL.json"), False),
-                        (os.path.join(art, "bench_last_good.json"),
-                         True)):
+    # best bench: BENCH_LOCAL (loop-banked, stamped banked_at on
+    # write) else last_good.  BOTH are subject to --since (ADVICE r4:
+    # nothing actually deleted BENCH_LOCAL at session start, so an
+    # unfiltered read let a prior round's number silently become this
+    # round's ledger row — the exact corruption the flag exists for).
+    # forward_only artifacts (the ladder's micro rung) are train-bench
+    # ineligible: a fwd-only images/sec in the ledger's throughput
+    # column would be the cross-metric corruption the micro rung's
+    # distinct metric name exists to prevent (they still appear under
+    # "rungs", labeled)
+    for p in (os.path.join(REPO, "BENCH_LOCAL.json"),
+              os.path.join(art, "bench_last_good.json")):
         d = _load(p)
         if (d and (d.get("value") or 0) > 0 and is_hardware(d)
-                and (not filtered or _fresh(d, since))):
+                and not d.get("forward_only") and _fresh(d, since)):
             out["bench"] = d["value"]
             out["mfu"] = d.get("mfu")
             out["bench_point"] = d.get("operating_point",
@@ -77,7 +83,10 @@ def collect(round_num: int, since: str | None = None) -> dict:
             break
     for p in sorted(glob.glob(os.path.join(art, "bench_rung_*.json"))):
         d = _load(p)
-        if d and is_hardware(d) and _fresh(d, since):
+        # value>0 mirrors the banking gate (ADVICE r4): a zero rung
+        # artifact must not be reported as a banked ladder rung
+        if (d and (d.get("value") or 0) > 0 and is_hardware(d)
+                and _fresh(d, since)):
             out["rungs"][d.get("operating_point",
                                os.path.basename(p))] = {
                 "value": d.get("value"), "mfu": d.get("mfu"),

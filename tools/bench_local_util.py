@@ -1,0 +1,97 @@
+"""Shared helper for BENCH_LOCAL.json handling (code review r5).
+
+The banked_at timestamp format, the staleness TTL, and the atomic
+stamped write previously lived as copy-pasted python -c snippets in
+THREE shell scripts (bench_retry_loop.sh, bench_supervisor.sh,
+tpu_harvest.sh) — all run under 2>/dev/null where any drift between
+copies silently misclassifies fresh hardware evidence as stale.  One
+implementation, three callers:
+
+    python tools/bench_local_util.py check [--path P] [--max-age S]
+        exit 0 = fresh (stamped within max-age), 1 = stale/unstamped/
+        unparseable/missing.
+    python tools/bench_local_util.py stamp --out P ( --from-file F | JSON )
+        add banked_at (UTC, second resolution) and write atomically
+        (tmp+mv) so pollers never see a partial file.
+
+Why a TTL at all: a leftover BENCH_LOCAL.json from a PRIOR round makes
+the supervisor exit instantly and the harvest chain off a stale number
+(ADVICE r4).  Age is an imperfect discriminator (rounds can be
+back-to-back), so session starts should still remove leftovers
+explicitly; this guard is defense-in-depth, and callers RENAME rather
+than delete so real hardware evidence is never destroyed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import calendar
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# single source of truth for the stamp format — bench.py's _bank writes
+# rung/last_good files with the same TS_FMT/utcnow, so the two writer
+# families cannot drift apart
+from bench import TS_FMT as FMT  # noqa: E402
+from bench import utcnow  # noqa: E402
+
+DEFAULT_MAX_AGE = 7200.0
+
+
+def is_fresh(path: str, max_age: float = DEFAULT_MAX_AGE) -> bool:
+    """True when ``path`` parses and carries a banked_at within
+    ``max_age`` seconds.  Anything else — missing file, bad JSON, no
+    stamp, unparseable stamp — is stale."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        ts = calendar.timegm(time.strptime(d["banked_at"], FMT))
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+    return time.time() - ts <= max_age
+
+
+def stamp(payload: dict, out: str) -> None:
+    """Write ``payload`` + banked_at to ``out`` atomically."""
+    rec = dict(payload)
+    rec["banked_at"] = utcnow()
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    import os
+
+    os.replace(tmp, out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("check")
+    c.add_argument("--path", default="BENCH_LOCAL.json")
+    c.add_argument("--max-age", type=float, default=DEFAULT_MAX_AGE)
+    s = sub.add_parser("stamp")
+    s.add_argument("--out", required=True)
+    s.add_argument("--from-file", default=None)
+    s.add_argument("json_line", nargs="?", default=None)
+    args = p.parse_args(argv)
+
+    if args.cmd == "check":
+        return 0 if is_fresh(args.path, args.max_age) else 1
+    if args.from_file:
+        with open(args.from_file) as f:
+            payload = json.load(f)
+    elif args.json_line:
+        payload = json.loads(args.json_line)
+    else:
+        p.error("stamp needs --from-file or an inline JSON argument")
+    stamp(payload, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,9 +6,18 @@
 # this loop (a) waits for any already-running bench to finish instead of
 # racing it, (b) gives each attempt a very generous deadline so we never
 # kill a compile in progress, and (c) backs off between attempts.
-# First success writes the JSON line to BENCH_LOCAL.json and exits; the
-# persistent compile cache makes every later bench run (incl. the
-# driver's round-end one) fast.
+# First success writes the JSON line to BENCH_LOCAL.json (stamped with
+# banked_at so round-end banking can apply its --since freshness filter
+# — ADVICE r4) and exits; the persistent compile cache makes every later
+# bench run (incl. the driver's round-end one) fast.
+#
+# Round 5 (VERDICT r4 next #7): bench.py now runs a sub-second TCP
+# pre-flight of the tunnel port before paying the init deadline.  A
+# pre-flight rejection cycles this loop in ~30s WITHOUT consuming the
+# ATTEMPTS budget, so a real attempt starts within seconds of the
+# tunnel coming up; every 20th consecutive rejection runs a bounded
+# full-init canary (EKSML_SKIP_PREFLIGHT=1) so a relay that moved
+# ports cannot permanently blind the bench.
 set -u
 cd "$(dirname "$0")/.."
 ATTEMPTS=${ATTEMPTS:-12}
@@ -22,27 +31,53 @@ if [ -n "${PER_RUN_TIMEOUT:-}" ]; then
          "tunnel); attempts run unbounded with a log-only watchdog" \
          >> bench_loop.log
 fi
-for i in $(seq 1 "$ATTEMPTS"); do
+i=0
+preflight_rejects=0
+while [ "$i" -lt "$ATTEMPTS" ]; do
     while pgrep -f "python bench.py" >/dev/null 2>&1; do sleep 60; done
-    echo "[loop] attempt $i/$ATTEMPTS $(date -u +%H:%M:%S)" >> bench_loop.log
+    canary=""
+    if [ "$preflight_rejects" -gt 0 ] \
+        && [ $((preflight_rejects % 20)) -eq 0 ]; then
+        # bounded full-init canary past the probe (false-negative
+        # insurance): 1 retry x 120s, ~2 min per ~10 min of rejections
+        canary=1
+        echo "[loop] canary full-init (preflight_rejects=$preflight_rejects)" \
+             "$(date -u +%H:%M:%S)" >> bench_loop.log
+    else
+        echo "[loop] attempt $((i + 1))/$ATTEMPTS $(date -u +%H:%M:%S)" \
+             >> bench_loop.log
+    fi
     # run in background + log-only watchdog: a post-init hang (e.g.
     # compile over a wedged tunnel) leaves a liveness trail in
     # bench_loop.log instead of silently blocking with no output
-    python bench.py --steps 20 --init-retries 3 --init-timeout 300 \
-        > .bench_out.tmp 2>>bench_loop.log &
+    if [ -n "$canary" ]; then
+        EKSML_SKIP_PREFLIGHT=1 python bench.py --steps 20 \
+            --init-retries 1 --init-timeout 120 \
+            > .bench_out.tmp 2>>bench_loop.log &
+    else
+        python bench.py --steps 20 --init-retries 3 --init-timeout 300 \
+            > .bench_out.tmp 2>>bench_loop.log &
+    fi
     bpid=$!
     elapsed=0
     while kill -0 "$bpid" 2>/dev/null; do
-        sleep 60
-        elapsed=$((elapsed + 60))
-        if [ $((elapsed % 600)) -eq 0 ]; then
-            echo "[loop] attempt $i still running after ${elapsed}s" \
+        sleep 15
+        elapsed=$((elapsed + 15))
+        if [ "$elapsed" -ge 600 ] && [ $((elapsed % 600)) -eq 0 ]; then
+            echo "[loop] attempt still running after ${elapsed}s" \
                  "(not killing: tunnel discipline)" >> bench_loop.log
         fi
     done
     wait "$bpid" 2>/dev/null
     out=$(tail -1 .bench_out.tmp 2>/dev/null)
-    echo "$out" >> bench_attempts.jsonl
+    # rate-limit pre-flight rejects in the attempts ledger (every 10th,
+    # matching bench_loop.log) — a multi-day dead window must not grow
+    # the file by a full diag line every ~45s (code review r5); real
+    # attempts and the first reject of each burst always land
+    if ! grep -q "pre-flight" <<< "$out" \
+        || [ $((preflight_rejects % 10)) -eq 0 ]; then
+        echo "$out" >> bench_attempts.jsonl
+    fi
     if python -c '
 import json, sys
 try:
@@ -50,15 +85,41 @@ try:
 except Exception:
     sys.exit(1)
 # hardware evidence only: a CPU-fallback backend must not declare the
-# headline landed (and must not unleash the harvest chain on CPU)
-ok = d.get("value", 0) > 0 and \
+# headline landed (and must not unleash the harvest chain on CPU).
+# A micro-rung-only ladder (forward_only) does not end the hunt either
+# — its rung file is banked, but this loop exists to land a TRAIN-step
+# number (code review r5).
+ok = d.get("value", 0) > 0 and not d.get("forward_only") and \
     d.get("device_kind", "").lower() not in ("", "cpu", "host")
 sys.exit(0 if ok else 1)' "$out"
     then
-        echo "$out" > BENCH_LOCAL.json
-        echo "[loop] success on attempt $i" >> bench_loop.log
+        # stamp banked_at so tools/bank_round.py --since can tell this
+        # session's number from a stale cross-round leftover; the util
+        # writes tmp+mv so pollers never see a partial file
+        python tools/bench_local_util.py stamp --out BENCH_LOCAL.json \
+            "$out"
+        echo "[loop] success $(date -u +%H:%M:%S)" >> bench_loop.log
         exit 0
     fi
+    if grep -q "pre-flight" <<< "$out"; then
+        preflight_rejects=$((preflight_rejects + 1))
+        if [ $((preflight_rejects % 10)) -eq 1 ]; then
+            echo "[loop] tunnel port closed (pre-flight x$preflight_rejects)" \
+                 "$(date -u +%H:%M:%S)" >> bench_loop.log
+        fi
+        sleep 30
+        continue  # fast-cycle; does NOT consume the ATTEMPTS budget
+    fi
+    if [ -n "$canary" ]; then
+        # a FAILED canary re-enters the fast cycle without i++: a
+        # multi-hour dead window must not exhaust ATTEMPTS through its
+        # own false-negative insurance (code review r5)
+        preflight_rejects=1
+        sleep 30
+        continue
+    fi
+    preflight_rejects=0
+    i=$((i + 1))
     sleep 300
 done
 echo "[loop] exhausted $ATTEMPTS attempts" >> bench_loop.log

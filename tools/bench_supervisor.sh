@@ -14,7 +14,24 @@
 set -u
 cd "$(dirname "$0")/.."
 LOG=bench_supervisor.log
-PROBE_PORT=${PROBE_PORT:-8103}
+# EKSML_TUNNEL_PORT is bench.py's preflight knob for the same port —
+# one operator setting moves both probes, with the SAME precedence as
+# bench.py (EKSML_TUNNEL_PORT wins, then PROBE_PORT, then default)
+PROBE_PORT=${EKSML_TUNNEL_PORT:-${PROBE_PORT:-8103}}
+
+# A leftover BENCH_LOCAL.json from a PRIOR round would make this
+# supervisor exit immediately and the harvest chain off a stale number
+# (ADVICE r4 / code review r5) — at startup, set aside any copy that
+# was never stamped or whose banked_at is >2h old.  Age-based (a
+# restart within 2h of the session's own success keeps it; an older
+# one is re-measured from the warm compile cache), and RENAMED, never
+# deleted — evidence is preserved either way.
+if [ -e BENCH_LOCAL.json ] \
+    && ! python tools/bench_local_util.py check 2>/dev/null; then
+    echo "[supervisor] $(date -u +%H:%M:%S) setting aside stale" \
+         "BENCH_LOCAL.json" >> "$LOG"
+    mv BENCH_LOCAL.json "BENCH_LOCAL.stale.$(date -u +%Y%m%dT%H%M%SZ).json"
+fi
 
 probe() {  # 0 = something is listening on the tunnel port
     (exec 3<>"/dev/tcp/127.0.0.1/$PROBE_PORT") 2>/dev/null \

@@ -53,7 +53,12 @@ def closure(roots=ROOTS) -> dict[str, tuple[str, str]]:
     seen: dict[str, tuple[str, str]] = {}
     queue = [(n, tuple(extras)) for n, extras in roots]
     while queue:
-        name, extras = queue.pop()
+        # BFS (pop(0)), NOT LIFO: every extras-bearing root must be
+        # visited with ITS extras before any transitive dep reaches it
+        # extras-less — LIFO visited jax via flax first, so jax[tpu]'s
+        # extras-gated deps (libtpu, requests) were only pinned by
+        # coincidence via unrelated closure members (ADVICE r4)
+        name, extras = queue.pop(0)
         key = _norm(name)
         if key in seen:
             continue

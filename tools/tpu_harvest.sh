@@ -65,8 +65,8 @@ for p in sorted(glob.glob("artifacts/roi_ab_*.json")):
     out.append({"run": p.split("/")[-1][:-5], **{k: d.get(k) for k in (
         "value", "step_time_ms", "mfu", "roi_backend", "roi_bwd",
         "image_size", "batch_size", "device_kind", "error")}})
-json.dump({"runs": out}, open("artifacts/roi_ab_r4.json", "w"), indent=1)
-print("merged", len(out), "runs into artifacts/roi_ab_r4.json")
+json.dump({"runs": out}, open("artifacts/roi_ab_r5.json", "w"), indent=1)
+print("merged", len(out), "runs into artifacts/roi_ab_r5.json")
 EOF
 }
 
@@ -74,14 +74,14 @@ run_convergence() {
     # Convergence at real model scale ON HARDWARE (VERDICT r3 next #4):
     # the full R50-FPN run that takes most of a day on the 1-core CPU
     # box finishes in minutes on the chip.  Gate: run only while no
-    # banked r4 artifact already shows a non-CPU run beating the r3
+    # banked r5 artifact already shows a non-CPU run beating the r3
     # CPU-hedge AP50 (0.5284); promote only a real-accelerator run that
     # does not regress it.  Banked to a separate file first so a
     # half-written artifact can never clobber a good one.
     if python -c '
 import json, sys
 try:
-    d = json.load(open("artifacts/convergence_r4.json"))
+    d = json.load(open("artifacts/convergence_r5.json"))
 except Exception:
     sys.exit(0)  # nothing banked: run
 ok = d.get("device", "cpu").lower() not in ("", "cpu", "host") \
@@ -96,7 +96,7 @@ sys.exit(1 if ok else 0)
         say "running TPU convergence (full R50-FPN, 512px, GN)"
         if python tools/convergence_run.py --steps 600 --size 512 \
             --batch-size 4 \
-            --out artifacts/convergence_r4_tpu.json \
+            --out artifacts/convergence_r5_tpu.json \
             --config RPN.TRAIN_PRE_NMS_TOPK=512 RPN.TRAIN_POST_NMS_TOPK=128 \
             RPN.TEST_PRE_NMS_TOPK=512 RPN.TEST_POST_NMS_TOPK=128 \
             FRCNN.BATCH_PER_IM=128 TRAIN.GRADIENT_CLIP=0.36 \
@@ -104,7 +104,7 @@ sys.exit(1 if ok else 0)
             >> "$LOG" 2>&1; then
             if reason=$(python -c '
 import json, sys
-d = json.load(open("artifacts/convergence_r4_tpu.json"))
+d = json.load(open("artifacts/convergence_r5_tpu.json"))
 if d.get("device", "").lower() in ("", "cpu", "host"):
     print("ran on CPU fallback"); sys.exit(1)
 try:
@@ -116,9 +116,9 @@ if d.get("bbox_AP50", 0) < old.get("bbox_AP50", 0):
         d.get("bbox_AP50", 0), old.get("bbox_AP50", 0)))
     sys.exit(1)
 '); then
-                cp artifacts/convergence_r4_tpu.json \
-                   artifacts/convergence_r4.json
-                say "TPU convergence banked as convergence_r4.json"
+                cp artifacts/convergence_r5_tpu.json \
+                   artifacts/convergence_r5.json
+                say "TPU convergence banked as convergence_r5.json"
             else
                 say "TPU convergence NOT promoted: $reason"
             fi
@@ -126,9 +126,21 @@ if d.get("bbox_AP50", 0) < old.get("bbox_AP50", 0):
             say "TPU convergence run FAILED its own checks (see log)"
         fi
     else
-        say "convergence_r4.json already strong on hardware; skipping"
+        say "convergence_r5.json already strong on hardware; skipping"
     fi
 }
+
+# same stale-headline guard as the supervisor (code review r5): a
+# leftover BENCH_LOCAL.json from a prior round must not unleash the
+# harvest chain — an unstamped or >2h-old copy is set aside (renamed,
+# not deleted).  The wait below then resumes: with BENCH_LOCAL gone the
+# supervisor keeps the retry loop hunting, and the warm compile cache
+# makes a re-landing cheap.
+if [ -e BENCH_LOCAL.json ] \
+    && ! python tools/bench_local_util.py check 2>/dev/null; then
+    say "setting aside stale BENCH_LOCAL.json"
+    mv BENCH_LOCAL.json "BENCH_LOCAL.stale.$(date -u +%Y%m%dT%H%M%SZ).json"
+fi
 
 if [ "$WAIT_HEADLINE" = "1" ]; then
     say "waiting for BENCH_LOCAL.json (ladder via bench_retry_loop)"
@@ -161,12 +173,12 @@ run_bench roi_ab_xla_1344 --steps 10 --roi-backend xla --roi-bwd xla
 run_bench roi_ab_bwd_pallas_1344 --steps 10 --roi-backend pallas \
     --roi-bwd pallas
 merge_ab
-say "full A/B grid merged into artifacts/roi_ab_r4.json"
+say "full A/B grid merged into artifacts/roi_ab_r5.json"
 
 # ---- Rung 4: train-step profile (go/no-go on a real trace) ---------
 run_bench bench_profiled --steps 10 --profile 8
 if python tools/trace_summary.py profile \
-    --out artifacts/profile_summary_r4.json >> "$LOG" 2>&1; then
+    --out artifacts/profile_summary_r5.json >> "$LOG" 2>&1; then
     say "profile summary banked"
 else
     say "profile summary FAILED — see above; trace left in ./profile"
@@ -181,15 +193,23 @@ d = json.load(open("BENCH_LOCAL.json"))
 sys.exit(0 if d.get("headline_point") else 1)' 2>/dev/null; then
     wait_for_bench_slot
     say "retrying full ladder for the headline point"
+    # tmp+mv atomic write, same as run_bench (ADVICE r4): a harvest
+    # killed mid-write must not leave a truncated artifact
     python bench.py --steps 20 --init-retries 3 --init-timeout 300 \
-        2>>"$LOG" | tail -1 > artifacts/bench_ladder_retry.json
+        2>>"$LOG" | tail -1 > artifacts/bench_ladder_retry.json.tmp \
+        && mv artifacts/bench_ladder_retry.json.tmp \
+              artifacts/bench_ladder_retry.json
     if python -c '
 import json, sys
 d = json.load(open("artifacts/bench_ladder_retry.json"))
 ok = d.get("value", 0) > 0 and d.get("headline_point") and \
     d.get("device_kind", "").lower() not in ("", "cpu", "host")
 sys.exit(0 if ok else 1)'; then
-        cp artifacts/bench_ladder_retry.json BENCH_LOCAL.json
+        # stamp banked_at (same contract as the loop's write): an
+        # unstamped BENCH_LOCAL fails bank_round's --since filter and
+        # the supervisor/harvest stale checks (code review r5)
+        python tools/bench_local_util.py stamp --out BENCH_LOCAL.json \
+            --from-file artifacts/bench_ladder_retry.json
         say "headline point upgraded into BENCH_LOCAL.json"
     else
         say "headline retry did not land; keeping banked ladder result"
