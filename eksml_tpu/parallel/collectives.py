@@ -25,42 +25,80 @@ from jax.sharding import Mesh, PartitionSpec as P
 log = logging.getLogger(__name__)
 
 
+_FLAG_PROBE_SCRIPT = """
+import os, sys, time
+os.environ["LIBTPU_INIT_ARGS"] = sys.argv[1]
+import jax, jax.numpy as jnp, numpy as np
+# The verdict is only meaningful from a TPU compile: if this child
+# fell back to CPU (e.g. the parent holds the device lock on a real
+# TPU host), a passing trivial jit proves nothing about the flag —
+# exit nonzero so the parent REJECTS rather than poisons itself.
+if jax.default_backend() != "tpu":
+    sys.exit(2)
+nonce = np.float32(time.time_ns() % 100003 + 2)
+jax.block_until_ready(
+    jax.jit(lambda x: x * nonce)(jnp.ones((8,), jnp.float32)))
+"""
+
+
+def _flag_probe_subprocess(flag: str, timeout: float) -> bool:
+    """Compile a nonce constant in a CHILD process with ``flag`` in
+    LIBTPU_INIT_ARGS; True iff the compile succeeds.  The nonce forces
+    a persistent-cache miss so a real compile always runs."""
+    import subprocess
+    import sys
+
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", _FLAG_PROBE_SCRIPT, flag],
+            timeout=timeout, capture_output=True).returncode == 0
+    except Exception:  # noqa: BLE001 — timeout/spawn failure = reject
+        return False
+
+
 def set_xla_collective_flags(combine_threshold_bytes: int,
                              validate: bool = True) -> None:
     """HOROVOD_FUSION_THRESHOLD analogue: how many bytes of gradient
     all-reduce XLA combines into one collective.  Must run before the
     backend compiles the train step.
 
-    The flag is VALIDATED with a throwaway compile when a TPU backend
-    is live: libtpu forwards ``LIBTPU_INIT_ARGS`` xla_* entries as
-    per-compile options, and a libtpu whose XLA revision doesn't know
-    the option rejects EVERY subsequent compile (observed on the v5e
-    tunnel this repo benches on).  A tuning knob must degrade to a
-    warning, not take down training."""
+    The flag is VALIDATED in a SUBPROCESS when a TPU backend is live,
+    and only set in THIS process after the child proves the option
+    compiles.  Two hardware-observed failure modes force this design:
+    (1) a libtpu whose XLA revision doesn't know the option rejects
+    EVERY subsequent compile; (2) the round-5 session proved the
+    rejection is STICKY per process — after one failed compile with
+    the bad flag, stripping it from the env did not recover the
+    process (every later compile kept failing), so an in-process
+    validate-then-strip can itself take down training.  The verdict is
+    cached in ``EKSML_ALLREDUCE_FLAG_OK`` (inherited by children) so
+    one probe serves the process tree; an operator-set LIBTPU value
+    always wins."""
     flags = os.environ.get("LIBTPU_INIT_ARGS", "")
-    if "all_reduce_combine_threshold" not in flags:
-        os.environ["LIBTPU_INIT_ARGS"] = (
-            f"{flags} --xla_tpu_all_reduce_combine_threshold_bytes="
-            f"{combine_threshold_bytes}").strip()
-    if not validate:
-        return
-    try:
-        if jax.default_backend() != "tpu":
+    if "all_reduce_combine_threshold" in flags:
+        return  # operator already decided
+    flag = (f"--xla_tpu_all_reduce_combine_threshold_bytes="
+            f"{combine_threshold_bytes}")
+    if validate:
+        try:
+            if jax.default_backend() != "tpu":
+                return
+        except Exception:  # noqa: BLE001 — backend init failure
             return
-        # unique constant → cache miss → exercises a real compile with
-        # the flag in effect (covers a chart-injected env value too)
-        probe = jax.jit(lambda x: x * np.float32(combine_threshold_bytes
-                                                 % 1009 + 2))
-        jax.block_until_ready(probe(jnp.ones((8,), jnp.float32)))
-    except Exception as e:  # noqa: BLE001 — any backend/compile failure
-        os.environ["LIBTPU_INIT_ARGS"] = " ".join(
-            t for t in os.environ["LIBTPU_INIT_ARGS"].split()
-            if "all_reduce_combine_threshold" not in t)
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "combine-threshold flag rejected by this libtpu — running "
-            "with XLA's default collective fusion (%s)", e)
+        verdict = os.environ.get("EKSML_ALLREDUCE_FLAG_OK")
+        if verdict is None:
+            timeout = float(os.environ.get(
+                "EKSML_FLAG_PROBE_TIMEOUT", "180"))
+            probe_flags = f"{flags} {flag}".strip()
+            verdict = ("1" if _flag_probe_subprocess(probe_flags,
+                                                     timeout) else "0")
+            os.environ["EKSML_ALLREDUCE_FLAG_OK"] = verdict
+        if verdict != "1":
+            log.warning(
+                "combine-threshold flag rejected by this libtpu — "
+                "running with XLA's default collective fusion")
+            return
+    os.environ["LIBTPU_INIT_ARGS"] = f"{flags} {flag}".strip()
 
 
 def warm_mesh_collectives(mesh: Mesh) -> None:
@@ -125,26 +163,65 @@ def cross_host_sum(tree):
     return jax.tree.map(lambda x: x.sum(axis=0), gathered)
 
 
-def param_fingerprint(params) -> jnp.ndarray:
-    """Cheap order-stable fingerprint of a param tree (sum of means +
-    leaf count mixing).  Equal across replicas ⇔ replicas in sync."""
-    leaves = jax.tree.leaves(params)
+def param_fingerprint(params, rng: jax.Array | None = None) -> jnp.ndarray:
+    """Order- and position-sensitive fingerprint of a param tree (plus,
+    optionally, the training PRNG key).  Equal across replicas ⇔
+    replicas in sync.
+
+    Three mixing terms per leaf, so the divergences a plain mean
+    misses still move the fingerprint:
+    - Weyl-weighted sum (weights ``frac(i·φ)+0.5`` over the flattened
+      leaf): position-sensitive, so permuting values within a leaf —
+      which preserves mean AND sum of squares — changes it;
+    - second moment: catches sign flips / rescalings that preserve a
+      weighted sum;
+    - leaf-index multiplier: catches two leaves swapping contents.
+
+    Returns a VECTOR fingerprint: component 0 is the param mix
+    (compared to ``atol``); when ``rng`` is given, each key word's
+    high and low 16 bits follow as separate components.  Each half-word
+    is < 2^16 and therefore EXACTLY representable in float32, so key
+    comparison is bit-exact and never dilutes the param component's
+    sensitivity (a lossy ``uint32→f32`` cast would round away low-bit
+    key divergence AND swamp atol with ~1e9-scale magnitudes).  A
+    diverged key stream corrupts training silently long before the
+    params drift apart (SURVEY.md §5.2)."""
+    phi = 0.6180339887498949  # Weyl increment: irrational ⇒ no period
     acc = jnp.zeros((), jnp.float32)
-    for i, leaf in enumerate(leaves):
-        acc = acc + jnp.float32((i % 97) + 1) * jnp.mean(
-            leaf.astype(jnp.float32))
-    return acc
+    for i, leaf in enumerate(jax.tree.leaves(params)):
+        flat = leaf.astype(jnp.float32).reshape(-1)
+        w = jnp.mod(jnp.arange(flat.shape[0], dtype=jnp.float32) * phi,
+                    1.0) + 0.5
+        n = jnp.float32(flat.shape[0])
+        mix = jnp.dot(w, flat) / n + 0.7 * jnp.dot(flat, flat) / n
+        acc = acc + jnp.float32((i % 97) + 1) * mix
+    parts = [acc.reshape(1)]
+    if rng is not None:
+        words = jax.random.key_data(rng).astype(jnp.uint32).reshape(-1)
+        parts.append((words >> 16).astype(jnp.float32))
+        parts.append((words & 0xFFFF).astype(jnp.float32))
+    return jnp.concatenate(parts)
 
 
 def assert_replicas_in_sync(params, mesh: Mesh, axis: str = "data",
-                            atol: float = 1e-5) -> bool:
+                            atol: float = 1e-5,
+                            rng: jax.Array | None = None) -> bool:
     """Debug mode (SURVEY.md §5.2): verify every data-parallel replica
-    holds identical parameters — the silent-divergence failure the
-    reference's Horovod stack can't detect.  Returns True when in sync;
-    raises otherwise."""
+    holds identical parameters (and, when given, the same PRNG key) —
+    the silent-divergence failure the reference's Horovod stack can't
+    detect.  Returns True when in sync; raises otherwise.
+
+    Why this works even though ``params`` claims replication: in
+    multi-process SPMD a "replicated" jax.Array's per-host shards can
+    genuinely differ (each host materialized them from diverged local
+    state — bad restore, nondeterministic host preprocessing, a
+    donation bug).  The fingerprint is computed per-device from the
+    LOCAL shard, then pmax/pmin over the mesh exposes any spread.
+    Negative-path proof: tests/test_parallel.py injects a divergent
+    buffer into a replicated array and asserts this raises."""
     from jax import shard_map
 
-    fp = param_fingerprint(params)
+    fp = param_fingerprint(params, rng=rng)
 
     def check(x):
         mine = x
@@ -155,8 +232,14 @@ def assert_replicas_in_sync(params, mesh: Mesh, axis: str = "data",
     out = shard_map(check, mesh=mesh, in_specs=P(), out_specs=P(None),
                     check_vma=False)(fp)
     mine, high, low = np.asarray(out)
-    if abs(high - low) > atol:
+    # component 0: param mix (float tolerance); components 1..: exact
+    # 16-bit PRNG key halves (any spread at all is divergence)
+    spread = np.abs(high - low)
+    if spread[0] > atol or (spread.shape[0] > 1
+                            and np.any(spread[1:] > 0)):
+        what = ("params" if spread[0] > atol else "PRNG key stream")
         raise AssertionError(
-            f"data-parallel replicas diverged: fingerprint spread "
-            f"[{low}, {high}] (mine={mine})")
+            f"data-parallel replicas diverged ({what}): fingerprint "
+            f"spread {spread.max()} (mine={mine.tolist()}, "
+            f"low={low.tolist()}, high={high.tolist()})")
     return True

@@ -335,7 +335,8 @@ class Trainer:
                 from eksml_tpu.parallel.collectives import \
                     assert_replicas_in_sync
 
-                assert_replicas_in_sync(state.params, self.mesh)
+                assert_replicas_in_sync(state.params, self.mesh,
+                                        rng=state.rng)
 
             if step % ckpt_every == 0 or step == total_steps:
                 # hand Orbax the sharded jax arrays directly: async

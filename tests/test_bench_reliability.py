@@ -340,31 +340,60 @@ def test_ladder_total_failure_surfaces_error(monkeypatch, tmp_path,
     assert diag["ladder_abort"]["rung"] == "micro_256_b1_fwd"
 
 
-def test_collective_flag_rollback_on_rejection(monkeypatch):
-    """A combine-threshold flag an old libtpu rejects must be rolled
-    back out of LIBTPU_INIT_ARGS (one bad flag otherwise fails EVERY
-    subsequent compile — observed live on the v5e tunnel)."""
+def test_collective_flag_never_set_when_probe_rejects(monkeypatch):
+    """A combine-threshold flag an old libtpu rejects must NEVER enter
+    this process's LIBTPU_INIT_ARGS.  Round-5 hardware proof that
+    validate-then-strip is not enough: after one failed compile with
+    the bad flag the rejection is sticky for the whole process (even
+    with the env stripped, every later compile failed) — so validation
+    runs in a SUBPROCESS and only a passing verdict sets the flag."""
     monkeypatch.setenv("LIBTPU_INIT_ARGS", "--xla_keep_me=1")
+    monkeypatch.delenv("EKSML_ALLREDUCE_FLAG_OK", raising=False)
     monkeypatch.setattr(collectives.jax, "default_backend",
                         lambda: "tpu")
-
-    def bad_jit(fn):
-        raise RuntimeError("Unknown flag: combine_threshold")
-
-    monkeypatch.setattr(collectives.jax, "jit", bad_jit)
+    monkeypatch.setattr(collectives, "_flag_probe_subprocess",
+                        lambda flag, timeout: False)
     collectives.set_xla_collective_flags(64 * 1024 * 1024)
     flags = os.environ["LIBTPU_INIT_ARGS"]
     assert "all_reduce_combine_threshold" not in flags
     assert "--xla_keep_me=1" in flags
+    assert os.environ["EKSML_ALLREDUCE_FLAG_OK"] == "0"
 
 
-def test_collective_flag_kept_when_probe_passes(monkeypatch):
+def test_collective_flag_set_when_probe_passes(monkeypatch):
+    """Verdicts are cached in the env: one subprocess probe serves the
+    process tree, later calls skip straight to setting the flag."""
     monkeypatch.setenv("LIBTPU_INIT_ARGS", "")
+    monkeypatch.delenv("EKSML_ALLREDUCE_FLAG_OK", raising=False)
     monkeypatch.setattr(collectives.jax, "default_backend",
-                        lambda: "cpu")  # no TPU -> no probe, flag kept
+                        lambda: "tpu")
+    calls = []
+
+    def probe(flag, timeout):
+        calls.append(flag)
+        return True
+
+    monkeypatch.setattr(collectives, "_flag_probe_subprocess", probe)
     collectives.set_xla_collective_flags(1234)
     assert "all_reduce_combine_threshold_bytes=1234" in \
         os.environ["LIBTPU_INIT_ARGS"]
+    assert len(calls) == 1
+    # operator/previous value present -> untouched, no second probe
+    collectives.set_xla_collective_flags(9999)
+    assert "all_reduce_combine_threshold_bytes=1234" in \
+        os.environ["LIBTPU_INIT_ARGS"]
+    assert len(calls) == 1
+
+
+def test_collective_flag_skipped_without_tpu(monkeypatch):
+    """No TPU backend -> LIBTPU flags are meaningless; leave the env
+    alone (and never pay a probe)."""
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", "")
+    monkeypatch.delenv("EKSML_ALLREDUCE_FLAG_OK", raising=False)
+    monkeypatch.setattr(collectives.jax, "default_backend",
+                        lambda: "cpu")
+    collectives.set_xla_collective_flags(1234)
+    assert os.environ["LIBTPU_INIT_ARGS"] == ""
 
 
 def test_last_good_banked_and_attached(monkeypatch, tmp_path, capsys):

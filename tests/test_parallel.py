@@ -103,7 +103,64 @@ def test_replica_sync_check():
     assert assert_replicas_in_sync(params, mesh)
     fp = param_fingerprint(params)
     fp2 = param_fingerprint({"w": jnp.ones((4, 4)) * 2})
-    assert float(fp) != float(fp2)
+    assert float(fp[0]) != float(fp2[0])
+    # rng inclusion appends exactly-representable 16-bit key halves
+    fp3 = param_fingerprint(params, rng=jax.random.PRNGKey(3))
+    assert fp3.shape[0] > 1 and float(fp3[0]) == float(fp[0])
+
+
+def _divergent_replicated(mesh, base, perturbed, bad_device=3):
+    """Build a jax.Array that CLAIMS full replication but whose buffer
+    on one device differs — the exact silent corruption SPMD trusts
+    away (multi-process restore divergence, donation bug, bitflip)."""
+    import numpy as _np
+
+    sharding = replicated_sharding(mesh)
+    bufs = []
+    for i, d in enumerate(mesh.devices.flatten()):
+        src = perturbed if i == bad_device else base
+        bufs.append(jax.device_put(_np.asarray(src), d))
+    return jax.make_array_from_single_device_arrays(
+        base.shape, sharding, bufs)
+
+
+def test_replica_sync_check_catches_injected_divergence():
+    # SURVEY.md §5.2 negative path: one device's replica is perturbed;
+    # the guard must raise, not silently pass
+    mesh = build_mesh()
+    base = np.ones((4, 4), np.float32)
+    bad = base.copy()
+    bad[2, 1] += 1e-2
+    params = {"w": _divergent_replicated(mesh, base, bad)}
+    with pytest.raises(AssertionError, match="diverged"):
+        assert_replicas_in_sync(params, mesh)
+
+
+def test_replica_sync_check_catches_permutation_divergence():
+    # a within-leaf permutation preserves mean AND sum of squares — a
+    # moment-only fingerprint would pass it; the Weyl position weights
+    # must not
+    mesh = build_mesh()
+    base = np.arange(16, dtype=np.float32).reshape(4, 4)
+    perm = base.reshape(-1)[::-1].reshape(4, 4).copy()
+    params = {"w": _divergent_replicated(mesh, base, perm)}
+    with pytest.raises(AssertionError, match="diverged"):
+        assert_replicas_in_sync(params, mesh)
+
+
+def test_replica_sync_check_catches_rng_divergence():
+    # identical params, diverged PRNG key stream (the failure mode that
+    # corrupts augmentation/dropout long before params drift)
+    mesh = build_mesh()
+    params = {"w": jax.device_put(jnp.ones((4, 4)),
+                                  replicated_sharding(mesh))}
+    k0 = np.asarray(jax.random.key_data(jax.random.PRNGKey(0)))
+    k1 = np.asarray(jax.random.key_data(jax.random.PRNGKey(7)))
+    raw = _divergent_replicated(mesh, k0, k1)
+    rng = jax.random.wrap_key_data(raw)
+    assert assert_replicas_in_sync(params, mesh)  # params alone: fine
+    with pytest.raises(AssertionError, match="diverged"):
+        assert_replicas_in_sync(params, mesh, rng=rng)
 
 
 def test_v5e_inventory_consistent():
