@@ -61,6 +61,30 @@ TILE = 64  # T: per-ROI feature tile (covers √area/stride ≲ 56 + taps)
 
 _PROBE_RESULTS: dict = {}  # dtype → cached hardware compile-probe
 
+# Round-5 hardware finding: Mosaic's default per-kernel scoped-vmem
+# stack is 16 MiB, and the production mask-head call (double-buffered
+# 64×64×256 tile scratch + vmem-resident output) needs ~16.16 MiB —
+# 160 KiB over, a hard compile reject.  v5e/v6e have 128 MiB of vmem
+# per core; granting the kernel a 32 MiB stack is comfortably safe and
+# is the documented tuning knob for exactly this ("kernel-vmem-stack-
+# oom").  Applied lazily from _gate() so every pallas-enabled entry
+# point (bench, trainer, predictor) gets it before the first compile,
+# and never when the XLA backend is forced.
+_SCOPED_VMEM_KIB = int(os.environ.get("EKSML_SCOPED_VMEM_KIB", "32768"))
+
+
+def ensure_scoped_vmem_limit(kib: int | None = None) -> None:
+    """Append ``--xla_tpu_scoped_vmem_limit_kib`` to LIBTPU_INIT_ARGS
+    (idempotent; an operator-provided value wins).  libtpu forwards
+    these as per-compile options, so setting it before the first pallas
+    compile suffices — same mechanism set_xla_collective_flags uses."""
+    flags = os.environ.get("LIBTPU_INIT_ARGS", "")
+    if "scoped_vmem_limit" in flags:
+        return
+    kib = kib or _SCOPED_VMEM_KIB
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        f"{flags} --xla_tpu_scoped_vmem_limit_kib={kib}").strip()
+
 
 def sublane_align(dtype) -> int:
     """Mosaic's second-to-last-dim tiling for HBM memrefs: 8 sublanes
@@ -77,13 +101,16 @@ def tile_margin(dtype) -> int:
 
 def _probe_fixture(dtype):
     """ONE probe fixture for fwd and bwd: production shape class —
-    4 FPN levels, C=256 (fpn.py) — so the multi-level @pl.when DMA
-    selection and full scratch size must compile, not just a toy
-    single-level variant."""
+    4 FPN levels, C=256 (fpn.py), and the MASK HEAD's ROI count ×
+    out_size (128 × 14², models/mask_rcnn.py) — the operating point
+    whose scoped-vmem stack Mosaic rejected on round-5 hardware while
+    a 2-ROI toy probe passed.  Probe-pass must imply production-
+    compile-pass, so probe the production stack shape."""
     feats = tuple(jnp.zeros((1, max(TILE, 256 // s), max(TILE, 256 // s),
                              256), dtype) for s in (4, 8, 16, 32))
-    rois = jnp.asarray([[[4.0, 4.0, 36.0, 36.0],
-                         [8.0, 8.0, 200.0, 120.0]]], jnp.float32)
+    base = np.asarray([[4.0, 4.0, 36.0, 36.0],
+                       [8.0, 8.0, 200.0, 120.0]], np.float32)
+    rois = jnp.asarray(np.tile(base, (64, 1))[None], jnp.float32)
     return feats, rois
 
 
@@ -97,7 +124,7 @@ def _probe_compile(dtype) -> bool:
     try:
         feats, rois = _probe_fixture(dtype)
         out = pallas_batched_multilevel_roi_align(
-            feats, rois, (4, 8, 16, 32), 7, 2, 2)
+            feats, rois, (4, 8, 16, 32), 14, 2, 2)
         jax.block_until_ready(out)
         return bool(np.isfinite(
             np.asarray(out, dtype=np.float32)).all())
@@ -113,6 +140,7 @@ def _gate(env_var: str, dtype, cache: dict, probe) -> bool:
     mode = os.environ.get(env_var, "auto").lower()
     if mode == "xla":
         return False
+    ensure_scoped_vmem_limit()
     if mode == "pallas":
         return True
     try:
@@ -417,6 +445,31 @@ def _pad_levels(feats, align):
     return out
 
 
+# Mosaic's per-kernel scoped-vmem stack is 16 MiB: when XLA elects to
+# keep a pallas output (or operand) resident in vmem, the WHOLE buffer
+# counts against the kernel's stack, not just the windowed block.  The
+# round-5 hardware compile proved it: the mask head's full
+# bf16[128,14,14,256] output (12.85 MiB) + the double-buffered tile
+# scratch overflowed the limit by 160 KiB and Mosaic rejected the
+# kernel.  The fix is static shape arithmetic, not a probe: chunk the
+# ROI grid so worst-case (full output vmem-resident + scratch +
+# headroom) provably fits.
+_VMEM_STACK_BUDGET = 13 * 2 ** 20   # leave ~3 MiB for spills/semaphores
+
+
+def _roi_chunk(n_total: int, out_size: int, c: int, dtype,
+               scratch_bytes: int) -> int:
+    """Largest divisor of ``n_total`` whose per-call stack estimate
+    (chunk's output + kernel scratch) fits the scoped-vmem budget."""
+    esize = jnp.dtype(dtype).itemsize
+    per_roi = out_size * out_size * c * esize
+    room = max(_VMEM_STACK_BUDGET - scratch_bytes, per_roi)
+    bound = max(room // per_roi, 1)
+    if n_total <= bound:
+        return n_total
+    return max(d for d in range(1, int(bound) + 1) if n_total % d == 0)
+
+
 def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
                     interpret):
     from jax.experimental import pallas as pl
@@ -431,25 +484,37 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
     kern = functools.partial(_kernel, out_size, sampling, num_levels,
                              align)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=8,
-        grid=(b * n,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
-        out_specs=pl.BlockSpec((1, out_size, out_size, c),
-                               lambda r, *_: (r, 0, 0, 0),
-                               memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((2, TILE, TILE, c), feats[0].dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
-    )
-    out = pl.pallas_call(
-        kern,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * n, out_size, out_size, c),
-                                       feats[0].dtype),
-        interpret=interpret,
-    )(*scalars, *feats)
+    esize = jnp.dtype(feats[0].dtype).itemsize
+    scratch_bytes = 2 * TILE * TILE * c * esize
+    chunk = _roi_chunk(b * n, out_size, c, feats[0].dtype, scratch_bytes)
+
+    def call(chunk_scalars, n_rois):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=8,
+            grid=(n_rois,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
+            out_specs=pl.BlockSpec((1, out_size, out_size, c),
+                                   lambda r, *_: (r, 0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((2, TILE, TILE, c), feats[0].dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        )
+        return pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n_rois, out_size, out_size, c),
+                                           feats[0].dtype),
+            interpret=interpret,
+        )(*chunk_scalars, *feats)
+
+    if chunk == b * n:
+        out = call(scalars, b * n)
+    else:
+        out = jnp.concatenate([
+            call(tuple(s[i:i + chunk] for s in scalars), chunk)
+            for i in range(0, b * n, chunk)], axis=0)
     return out.reshape(b, n, out_size, out_size, c)
 
 
@@ -470,31 +535,47 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
                              num_levels, align)
 
     g_flat = g.reshape(b * n, out_size, out_size, c)
-    zeros = tuple(jnp.zeros(f.shape, jnp.float32) for f in padded)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=8,
-        grid=(b * n,),
-        in_specs=[pl.BlockSpec((1, out_size, out_size, c),
-                               lambda r, *_: (r, 0, 0, 0),
-                               memory_space=pltpu.VMEM)]
-        + [pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
-        scratch_shapes=[
-            pltpu.VMEM((TILE, TILE, c), jnp.float32),
-            pltpu.SemaphoreType.DMA(()),
-        ],
-    )
-    outs = pl.pallas_call(
-        kern,
-        grid_spec=grid_spec,
-        out_shape=tuple(jax.ShapeDtypeStruct(f.shape, jnp.float32)
-                        for f in padded),
-        # zero-input i (flat arg index 8 scalars + 1 g + i) owns output
-        # buffer i: the accumulators start as zeros and the kernel RMWs
-        # them through the out refs
-        input_output_aliases={9 + i: i for i in range(num_levels)},
-        interpret=interpret,
-    )(*scalars, g_flat, *zeros)
+
+    # Same scoped-vmem stack bound as the forward, from the other side:
+    # the incoming gradient is this kernel's big windowed buffer, and
+    # XLA electing to keep it vmem-resident would put all b·n ROIs of
+    # it on the Mosaic stack.  Chunk the ROI grid and CHAIN the calls
+    # through the aliased accumulators — each call RMWs the previous
+    # call's partial feature gradients, so memory stays bounded and no
+    # extra adds are emitted.
+    esize = jnp.dtype(jnp.float32).itemsize
+    scratch_bytes = TILE * TILE * c * esize
+    chunk = _roi_chunk(b * n, out_size, c, g_flat.dtype, scratch_bytes)
+
+    def call(chunk_scalars, g_chunk, accs, n_rois):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=8,
+            grid=(n_rois,),
+            in_specs=[pl.BlockSpec((1, out_size, out_size, c),
+                                   lambda r, *_: (r, 0, 0, 0),
+                                   memory_space=pltpu.VMEM)]
+            + [pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
+            scratch_shapes=[
+                pltpu.VMEM((TILE, TILE, c), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        )
+        return pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=tuple(jax.ShapeDtypeStruct(f.shape, jnp.float32)
+                            for f in padded),
+            # accumulator i (flat arg index 8 scalars + 1 g + i) owns
+            # output buffer i: the kernel RMWs it through the out refs
+            input_output_aliases={9 + i: i for i in range(num_levels)},
+            interpret=interpret,
+        )(*chunk_scalars, g_chunk, *accs)
+
+    outs = tuple(jnp.zeros(f.shape, jnp.float32) for f in padded)
+    for i in range(0, b * n, chunk):
+        outs = call(tuple(s[i:i + chunk] for s in scalars),
+                    g_flat[i:i + chunk], outs, chunk)
     return tuple(
         o[:, :f.shape[1], :f.shape[2], :].astype(f.dtype)
         for o, f in zip(outs, feats))
@@ -509,8 +590,8 @@ def _probe_bwd_compile(dtype) -> bool:
     interpret accepts)."""
     try:
         feats, rois = _probe_fixture(dtype)
-        g = jnp.ones((1, 2, 7, 7, 256), dtype)
-        out = _pallas_backward(feats, rois, g, (4, 8, 16, 32), 7, 2, 2,
+        g = jnp.ones((1, 128, 14, 14, 256), dtype)
+        out = _pallas_backward(feats, rois, g, (4, 8, 16, 32), 14, 2, 2,
                                False)
         jax.block_until_ready(out)
         return all(bool(np.isfinite(np.asarray(o, np.float32)).all())

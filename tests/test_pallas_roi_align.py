@@ -281,3 +281,64 @@ def test_gate_probe_runs_pallas_call_mid_trace(monkeypatch):
     res = traced(jnp.zeros(()))
     assert cache == {"float32": True}, cache
     assert float(res) == 1.0
+
+
+def test_vmem_chunk_math_covers_observed_hardware_oom():
+    """The round-5 hardware compile failure: mask head, 128 ROIs x
+    14x14 x 256ch bf16 — full output 12.85 MiB + 4 MiB scratch
+    overflowed Mosaic's 16 MiB scoped-vmem stack by 160 KiB.  The
+    static chunk bound must split exactly this case (and the box
+    head's equivalent) under budget."""
+    from eksml_tpu.ops.pallas.roi_align_kernel import (
+        TILE, _VMEM_STACK_BUDGET, _roi_chunk)
+
+    for n, out in ((128, 14), (512, 7)):  # mask head / box head
+        c, esize = 256, 2  # bf16
+        scratch = 2 * TILE * TILE * c * esize
+        chunk = _roi_chunk(n, out, c, jnp.bfloat16, scratch)
+        assert n % chunk == 0
+        assert chunk < n  # the failing case MUST be split
+        assert chunk * out * out * c * esize + scratch <= _VMEM_STACK_BUDGET
+    # small calls stay single-shot (no perf regression on probes)
+    assert _roi_chunk(6, 7, 32, jnp.float32,
+                      2 * TILE * TILE * 32 * 4) == 6
+
+
+def test_forward_chunked_matches_unchunked(monkeypatch):
+    """Force the chunked forward path (budget shrunk so n=12 splits)
+    and assert bit-identical output vs the single-call path — each
+    ROI's computation is independent, so chunking must be invisible."""
+    from eksml_tpu.ops.pallas import roi_align_kernel as rk
+
+    rng = np.random.RandomState(7)
+    feats = _feats(rng, b=2)
+    rois = _rois(rng, 2, 6)
+    whole = rk._pallas_forward(feats, rois, STRIDES, 7, 2, 2, True)
+    esize = 4
+    scratch = 2 * rk.TILE * rk.TILE * 32 * esize
+    monkeypatch.setattr(rk, "_VMEM_STACK_BUDGET",
+                        scratch + 4 * 7 * 7 * 32 * esize)
+    assert rk._roi_chunk(12, 7, 32, jnp.float32, scratch) == 4
+    chunked = rk._pallas_forward(feats, rois, STRIDES, 7, 2, 2, True)
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(chunked))
+
+
+def test_backward_chunked_matches_unchunked(monkeypatch):
+    """Same forcing for the backward: the chained aliased-accumulator
+    chunks must reproduce the single-call feature gradients."""
+    from eksml_tpu.ops.pallas import roi_align_kernel as rk
+
+    rng = np.random.RandomState(8)
+    feats = _feats(rng, b=1)
+    rois = _rois(rng, 1, 6)
+    g = jnp.asarray(rng.randn(1, 6, 7, 7, 32).astype(np.float32))
+    whole = rk._pallas_backward(feats, rois, g, STRIDES, 7, 2, 2, True)
+    esize = 4
+    scratch = rk.TILE * rk.TILE * 32 * esize
+    monkeypatch.setattr(rk, "_VMEM_STACK_BUDGET",
+                        scratch + 2 * 7 * 7 * 32 * esize)
+    assert rk._roi_chunk(6, 7, 32, jnp.float32, scratch) == 2
+    chunked = rk._pallas_backward(feats, rois, g, STRIDES, 7, 2, 2, True)
+    for w, ch in zip(whole, chunked):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(ch),
+                                   atol=1e-5)
