@@ -17,11 +17,14 @@ import numpy as np
 
 IOU_THRESHS = np.linspace(0.5, 0.95, 10)
 RECALL_POINTS = np.linspace(0.0, 1.0, 101)
+# official areaRng values; the in-range test is INCLUSIVE of the upper
+# bound (lo <= area <= hi), matching COCOeval's
+# ``area < aRng[0] or area > aRng[1]`` ignore predicate
 AREA_RANGES = {
-    "all": (0.0, 1e10),
+    "all": (0.0, 1e5 ** 2),
     "small": (0.0, 32.0 ** 2),
     "medium": (32.0 ** 2, 96.0 ** 2),
-    "large": (96.0 ** 2, 1e10),
+    "large": (96.0 ** 2, 1e5 ** 2),
 }
 
 
@@ -76,6 +79,16 @@ def mask_iou(det_masks: Sequence, gt_masks: Sequence,
                 union = d.sum() + ga - inter
             ious[i, j] = inter / union if union > 0 else 0.0
     return ious
+
+
+def _mask_area(m) -> float:
+    """Area of one detection mask: foreground pixel count, accepting
+    dense [H, W] arrays or uncompressed COCO RLE dicts (counts
+    alternate background/foreground runs starting with background)."""
+    if isinstance(m, dict):
+        counts = m["counts"]
+        return float(sum(counts[1::2]))
+    return float(np.asarray(m).astype(bool).sum())
 
 
 class COCOEvaluator:
@@ -142,9 +155,11 @@ class COCOEvaluator:
 
     # -- the match/accumulate pipeline --------------------------------
 
-    def _evaluate_pair(self, iid: int, cls: int):
-        """Greedy matching for one (image, class); returns per-det and
-        per-gt match info for all IoU thresholds."""
+    def _pair_ious(self, iid: int, cls: int):
+        """IoU matrix + sorted det/gt data for one (image, class) —
+        range-independent, computed ONCE and reused by every area
+        range's matching pass (official COCOeval computes IoUs in
+        computeIoU, separate from the per-range evaluateImg)."""
         g = self.gt.get((iid, cls))
         d = self.dets.get((iid, cls))
         if g is None and d is None:
@@ -163,37 +178,60 @@ class COCOEvaluator:
 
         if self.iou_type == "bbox":
             ious = box_iou_xywh(d_xywh, g_xywh, g_crowd)
+            d_area = d_xywh[:, 2] * d_xywh[:, 3]
         else:
             d_masks = [d["masks"][i] for i in order] if d else []
             ious = mask_iou(d_masks, g["masks"] if g else [], g_crowd)
+            # official: a segm detection's area is its MASK area
+            d_area = np.asarray([_mask_area(m) for m in d_masks],
+                                np.float64)
+        return {
+            "ious": ious, "score": d_score, "dt_area": d_area,
+            "gt_area": g_area, "gt_crowd": g_crowd.astype(bool),
+        }
+
+    def _evaluate_pair(self, pair, lo: float, hi: float):
+        """The official evaluateImg for one (image, class, area range):
+        gt ignore = crowd OR area outside [lo, hi] (inclusive hi), gt
+        visited ignored-LAST, matching prefers unignored gt (the scan
+        breaks at the first ignored gt once an unignored match is
+        held), crowd gt may absorb multiple detections, and unmatched
+        out-of-range detections are ignored.  Matching once globally
+        and reclassifying per range (rounds 1-4) skews range-restricted
+        metrics: a det whose best global match is out-of-range would
+        have matched a different, in-range gt here (cross-validated
+        against tests/coco_oracle.py; AP_small was off by up to 0.33
+        absolute on adversarial fixtures)."""
+        ious = pair["ious"]
+        g_crowd = pair["gt_crowd"]
+        g_area = pair["gt_area"]
+        g_ignore = g_crowd | (g_area < lo) | (g_area > hi)
+        g_order = np.argsort(g_ignore, kind="mergesort")
 
         T = len(IOU_THRESHS)
-        D, G = len(d_xywh), len(g_xywh)
-        # sort gt: non-crowd first (pycocotools sorts by ignore flag)
-        g_order = np.argsort(g_crowd, kind="mergesort")
-
+        D, G = ious.shape
         native = None
         if D and G:
             from eksml_tpu.evalcoco.native import greedy_match_native
 
-            native = greedy_match_native(ious, g_crowd, g_order,
-                                         IOU_THRESHS)
+            native = greedy_match_native(ious, g_crowd, g_ignore,
+                                         g_order, IOU_THRESHS)
         if native is not None:
-            dt_match, dt_crowd, gt_match = native
+            dt_match, dt_ignore, gt_match = native
         else:
             dt_match = np.zeros((T, D), np.int64) - 1   # matched gt idx
-            dt_crowd = np.zeros((T, D), bool)           # matched crowd
+            dt_ignore = np.zeros((T, D), bool)          # matched ignored
             gt_match = np.zeros((T, G), bool)
             for t, thr in enumerate(IOU_THRESHS):
                 for di in range(D):
-                    best = thr - 1e-10
+                    best = min(thr, 1 - 1e-10)
                     best_g = -1
                     for gj in g_order:
                         if gt_match[t, gj] and not g_crowd[gj]:
                             continue
-                        # non-crowd match found; don't downgrade
-                        if (best_g > -1 and not g_crowd[best_g]
-                                and g_crowd[gj]):
+                        # unignored match held; stop at ignored gt
+                        if (best_g > -1 and not g_ignore[best_g]
+                                and g_ignore[gj]):
                             break
                         if ious[di, gj] < best:
                             continue
@@ -201,13 +239,16 @@ class COCOEvaluator:
                         best_g = gj
                     if best_g >= 0:
                         dt_match[t, di] = best_g
-                        dt_crowd[t, di] = bool(g_crowd[best_g])
+                        dt_ignore[t, di] = bool(g_ignore[best_g])
                         if not g_crowd[best_g]:
                             gt_match[t, best_g] = True
+        d_out = (pair["dt_area"] < lo) | (pair["dt_area"] > hi)
+        dt_ignore = dt_ignore | ((dt_match < 0) & d_out[None, :])
         return {
-            "score": d_score, "dt_match": dt_match, "dt_crowd": dt_crowd,
-            "dt_area": d_xywh[:, 2] * d_xywh[:, 3],
-            "gt_area": g_area, "gt_crowd": g_crowd.astype(bool),
+            "score": pair["score"],
+            "matched": dt_match >= 0,
+            "ignore": dt_ignore,
+            "npig": int((~g_ignore).sum()),
         }
 
     def accumulate(self) -> Dict[str, float]:
@@ -216,54 +257,43 @@ class COCOEvaluator:
         image_ids = sorted(set(self.image_ids))
         T = len(IOU_THRESHS)
         results = {}
-        # evaluate every (image, class) once
-        per_pair = {}
+        # IoUs once per (image, class); matching per area range below
+        pair_ious = {}
         for c in classes:
             for iid in image_ids:
-                r = self._evaluate_pair(iid, c)
-                if r is not None:
-                    per_pair[(iid, c)] = r
+                p = self._pair_ious(iid, c)
+                if p is not None:
+                    pair_ious[(iid, c)] = p
 
         for range_name, (lo, hi) in AREA_RANGES.items():
             ap_per_class = []
             ar_per_class = []
             for c in classes:
-                scores, matched, crowd_m = [], [], []
+                scores, matched, ignored = [], [], []
                 n_gt = 0
                 for iid in image_ids:
-                    r = per_pair.get((iid, c))
-                    if r is None:
+                    p = pair_ious.get((iid, c))
+                    if p is None:
                         continue
-                    g_ok = (~r["gt_crowd"] & (r["gt_area"] >= lo)
-                            & (r["gt_area"] < hi))
-                    n_gt += int(g_ok.sum())
-                    # det-level ignore: matched to crowd, or out of range
-                    d_in = (r["dt_area"] >= lo) & (r["dt_area"] < hi)
-                    # dets matched to out-of-range gt are ignored too
-                    gt_area_of_match = np.where(
-                        r["dt_match"] >= 0,
-                        r["gt_area"][np.clip(r["dt_match"], 0, None)]
-                        if len(r["gt_area"]) else 0.0, -1.0)
-                    ignore = r["dt_crowd"] | (
-                        (r["dt_match"] >= 0)
-                        & ((gt_area_of_match < lo)
-                           | (gt_area_of_match >= hi))) | (
-                        (r["dt_match"] < 0) & ~d_in[None, :])
+                    r = self._evaluate_pair(p, lo, hi)
+                    n_gt += r["npig"]
                     scores.append(r["score"])
-                    matched.append(r["dt_match"] >= 0)
-                    crowd_m.append(ignore)
+                    matched.append(r["matched"])
+                    ignored.append(r["ignore"])
                 if n_gt == 0:
                     continue
                 if scores:
                     sc = np.concatenate(scores)
                     order = np.argsort(-sc, kind="mergesort")
                     m = np.concatenate(matched, axis=1)[:, order]
-                    ig = np.concatenate(crowd_m, axis=1)[:, order]
+                    ig = np.concatenate(ignored, axis=1)[:, order]
                 else:
                     m = np.zeros((T, 0), bool)
                     ig = np.zeros((T, 0), bool)
                 ap_t, ar_t = [], []
                 for t in range(T):
+                    # a det matched to an IGNORED gt is excluded
+                    # entirely (neither TP nor FP), per official tps/fps
                     keep = ~ig[t]
                     tp = np.cumsum(m[t][keep])
                     fp = np.cumsum(~m[t][keep])
@@ -272,7 +302,7 @@ class COCOEvaluator:
                         ar_t.append(0.0)
                         continue
                     rec = tp / n_gt
-                    prec = tp / np.maximum(tp + fp, 1e-12)
+                    prec = tp / (tp + fp + np.spacing(1))
                     # monotone non-increasing interpolation
                     for i in range(len(prec) - 1, 0, -1):
                         prec[i - 1] = max(prec[i - 1], prec[i])
@@ -295,6 +325,7 @@ class COCOEvaluator:
                     results["AP75"] = float(ap[:, 5].mean())
             else:
                 results[f"AP_{range_name}"] = -1.0
+                results[f"AR_{range_name}"] = -1.0
         for k in ("AP", "AP50", "AP75"):
             results.setdefault(k, -1.0)
         return results

@@ -39,7 +39,7 @@ def _declare(lib: ctypes.CDLL) -> None:
                             ctypes.c_int64, u8p, f64p]
     lib.rle_iou.restype = None
     lib.greedy_match.argtypes = [f64p, ctypes.c_int64, ctypes.c_int64,
-                                 u8p, i64p, f64p, ctypes.c_int64,
+                                 u8p, u8p, i64p, f64p, ctypes.c_int64,
                                  i64p, u8p, u8p]
     lib.greedy_match.restype = None
 
@@ -178,30 +178,35 @@ def rle_encode_native(mask: np.ndarray) -> Optional[list]:
 
 
 def greedy_match_native(ious: np.ndarray, crowd: np.ndarray,
-                        g_order: np.ndarray, threshs: np.ndarray):
-    """Greedy det→gt matching at every IoU threshold via the C++ path;
-    None when the library is unavailable (caller falls back to the
-    python loop in cocoeval.py).  Returns (dt_match [T,D] int64,
-    dt_crowd [T,D] bool, gt_match [T,G] bool)."""
+                        ignore: np.ndarray, g_order: np.ndarray,
+                        threshs: np.ndarray):
+    """Greedy det→gt matching at every IoU threshold via the C++ path
+    (official evaluateImg semantics: ``ignore`` = crowd OR out of the
+    current area range, ``g_order`` ignored-last); None when the
+    library is unavailable (caller falls back to the python loop in
+    cocoeval.py).  Returns (dt_match [T,D] int64, dt_ignore [T,D]
+    bool, gt_match [T,G] bool)."""
     lib = get_lib()
     if lib is None:
         return None
     ious = np.ascontiguousarray(ious, np.float64)
     d_n, g_n = ious.shape
     crowd = np.ascontiguousarray(crowd, np.uint8)
+    ignore = np.ascontiguousarray(ignore, np.uint8)
     g_order = np.ascontiguousarray(g_order, np.int64)
     threshs = np.ascontiguousarray(threshs, np.float64)
     t_n = len(threshs)
     dt_match = np.empty((t_n, d_n), np.int64)
-    dt_crowd = np.zeros((t_n, d_n), np.uint8)
+    dt_ignore = np.zeros((t_n, d_n), np.uint8)
     gt_match = np.zeros((t_n, g_n), np.uint8)
     f64p = ctypes.POINTER(ctypes.c_double)
     u8p = ctypes.POINTER(ctypes.c_uint8)
     i64p = ctypes.POINTER(ctypes.c_int64)
     lib.greedy_match(
         ious.ctypes.data_as(f64p), d_n, g_n,
-        crowd.ctypes.data_as(u8p), g_order.ctypes.data_as(i64p),
+        crowd.ctypes.data_as(u8p), ignore.ctypes.data_as(u8p),
+        g_order.ctypes.data_as(i64p),
         threshs.ctypes.data_as(f64p), t_n,
-        dt_match.ctypes.data_as(i64p), dt_crowd.ctypes.data_as(u8p),
+        dt_match.ctypes.data_as(i64p), dt_ignore.ctypes.data_as(u8p),
         gt_match.ctypes.data_as(u8p))
-    return dt_match, dt_crowd.astype(bool), gt_match.astype(bool)
+    return dt_match, dt_ignore.astype(bool), gt_match.astype(bool)

@@ -142,25 +142,33 @@ void rle_iou(const uint32_t* det_counts, const int64_t* det_off,
 // Outputs: dt_match [T, D] int64 (matched gt index or -1),
 //          dt_crowd [T, D] uint8, gt_match [T, G] uint8.
 void greedy_match(const double* ious, int64_t D, int64_t G,
-                  const uint8_t* crowd, const int64_t* g_order,
+                  const uint8_t* crowd, const uint8_t* ignore,
+                  const int64_t* g_order,
                   const double* threshs, int64_t T,
-                  int64_t* dt_match, uint8_t* dt_crowd,
+                  int64_t* dt_match, uint8_t* dt_ignore,
                   uint8_t* gt_match) {
+  // Official evaluateImg semantics: `ignore` = crowd OR out of the
+  // current area range; matched NON-CROWD gt are skipped (crowd can
+  // absorb multiple dets), and once an UNIGNORED match is held the
+  // scan breaks at the first ignored gt (g_order is ignored-last).
+  // An equal IoU later in g_order displaces the held match (official
+  // uses `< iou` to reject, so ties take the later gt).
   for (int64_t t = 0; t < T; ++t) {
     int64_t* dm = dt_match + t * D;
-    uint8_t* dc = dt_crowd + t * D;
+    uint8_t* dc = dt_ignore + t * D;
     uint8_t* gm = gt_match + t * G;
     for (int64_t i = 0; i < D; ++i) dm[i] = -1;
     std::memset(dc, 0, D);
     std::memset(gm, 0, G);
+    const double thr =
+        threshs[t] < 1.0 - 1e-10 ? threshs[t] : 1.0 - 1e-10;
     for (int64_t di = 0; di < D; ++di) {
-      double best = threshs[t] - 1e-10;
+      double best = thr;
       int64_t best_g = -1;
       for (int64_t k = 0; k < G; ++k) {
         const int64_t gj = g_order[k];
         if (gm[gj] && !crowd[gj]) continue;
-        // non-crowd match found; don't downgrade to crowd
-        if (best_g > -1 && !crowd[best_g] && crowd[gj]) break;
+        if (best_g > -1 && !ignore[best_g] && ignore[gj]) break;
         const double v = ious[di * G + gj];
         if (v < best) continue;
         best = v;
@@ -168,7 +176,7 @@ void greedy_match(const double* ious, int64_t D, int64_t G,
       }
       if (best_g >= 0) {
         dm[di] = best_g;
-        dc[di] = crowd[best_g] ? 1 : 0;
+        dc[di] = ignore[best_g] ? 1 : 0;
         if (!crowd[best_g]) gm[best_g] = 1;
       }
     }

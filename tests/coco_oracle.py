@@ -1,0 +1,223 @@
+"""Test-only oracle: a LITERAL, loop-based transcription of official
+pycocotools COCOeval semantics (the C/Cython toolkit the reference
+images install, /root/reference/container/Dockerfile:12).
+
+pycocotools cannot be installed in this environment (zero egress), so
+cross-validation of eksml_tpu/evalcoco runs against this independent
+second implementation instead: written directly from the official
+algorithm's published structure (evaluateImg / accumulate), scalar
+loops throughout, sharing NO code with the vectorized evaluator under
+test.  Anywhere the two disagree on an adversarial fixture, one of
+them is wrong — and this one is deliberately the boring, obviously-
+faithful one.
+
+Faithfully reproduced official behaviors (each one a historical source
+of silent AP skew):
+- per-AREA-RANGE matching: gt ignore = iscrowd OR area outside the
+  range, gt sorted ignored-last, and matching PREFERS unignored gt
+  (the scan breaks at the first ignored gt once an unignored match is
+  held);
+- crowd gt may absorb multiple detections (matched non-crowd gt are
+  skipped, matched crowd gt are not);
+- the best-IoU threshold starts at ``min(t, 1 - 1e-10)`` and a later
+  gt must STRICTLY exceed the held best to displace it (>= keeps the
+  earlier gt in the ignore-sorted order);
+- unmatched detections with area outside the range are ignored (not
+  false positives);
+- the official area test is INCLUSIVE of the upper bound:
+  in-range ⇔ lo <= area <= hi;
+- score sorts are descending mergesort (stable for ties);
+- 101-point interpolation via monotone precision + searchsorted
+  (side='left'), zeros past the last recall point;
+- a (class, range) with zero unignored gt contributes -1 and is
+  EXCLUDED from the mean.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+IOU_THRESHS = np.linspace(0.5, 0.95, 10)
+RECALL_POINTS = np.linspace(0.0, 1.0, 101)
+# official areaRng values (COCOeval.setDetParams)
+AREA_RANGES = {
+    "all": (0.0, 1e5 ** 2),
+    "small": (0.0, 32.0 ** 2),
+    "medium": (32.0 ** 2, 96.0 ** 2),
+    "large": (96.0 ** 2, 1e5 ** 2),
+}
+
+
+def _box_iou_single(d, g, crowd):
+    """IoU of one xywh det against one xywh gt (IoF when crowd)."""
+    ix = min(d[0] + d[2], g[0] + g[2]) - max(d[0], g[0])
+    iy = min(d[1] + d[3], g[1] + g[3]) - max(d[1], g[1])
+    if ix <= 0 or iy <= 0:
+        return 0.0
+    inter = ix * iy
+    da = d[2] * d[3]
+    ga = g[2] * g[3]
+    union = da if crowd else da + ga - inter
+    return inter / union if union > 0 else 0.0
+
+
+def _mask_iou_single(d, g, crowd):
+    d = d.astype(bool)
+    g = g.astype(bool)
+    inter = float(np.logical_and(d, g).sum())
+    union = float(d.sum()) if crowd else float(d.sum() + g.sum() - inter)
+    return inter / union if union > 0 else 0.0
+
+
+class OracleEval:
+    """gt_images: {image_id: list of gt dicts (per class fields below)}.
+
+    gt dict: {"bbox": xywh, "area": float, "iscrowd": 0/1,
+              "category_id": int, "mask": optional HxW}
+    dt dict: {"bbox": xywh, "score": float, "category_id": int,
+              "mask": optional HxW}
+    """
+
+    def __init__(self, iou_type="bbox", max_dets=100):
+        self.iou_type = iou_type
+        self.max_dets = max_dets
+        self.gts = {}   # image_id -> [gt]
+        self.dts = {}   # image_id -> [dt]
+
+    def add_gt(self, image_id, gts):
+        self.gts.setdefault(image_id, []).extend(gts)
+
+    def add_dt(self, image_id, dts):
+        self.dts.setdefault(image_id, []).extend(dts)
+
+    # -- one evaluateImg call: (image, class, area range) -------------
+    def _evaluate_img(self, iid, cat, lo, hi):
+        gt = [g for g in self.gts.get(iid, [])
+              if g["category_id"] == cat]
+        dt = [d for d in self.dts.get(iid, [])
+              if d["category_id"] == cat]
+        if not gt and not dt:
+            return None
+        for g in gt:
+            g["_ignore"] = 1 if (g["iscrowd"]
+                                 or g["area"] < lo
+                                 or g["area"] > hi) else 0
+        # stable: unignored gt first, original order within groups
+        gtind = sorted(range(len(gt)), key=lambda i: gt[i]["_ignore"])
+        gt = [gt[i] for i in gtind]
+        # descending stable score sort, truncate to maxDets
+        dtind = sorted(range(len(dt)), key=lambda i: -dt[i]["score"])
+        dt = [dt[i] for i in dtind][: self.max_dets]
+
+        T = len(IOU_THRESHS)
+        D, G = len(dt), len(gt)
+        ious = np.zeros((D, G))
+        for di, d in enumerate(dt):
+            for gj, g in enumerate(gt):
+                if self.iou_type == "bbox":
+                    ious[di, gj] = _box_iou_single(
+                        d["bbox"], g["bbox"], g["iscrowd"])
+                else:
+                    ious[di, gj] = _mask_iou_single(
+                        d["mask"], g["mask"], g["iscrowd"])
+
+        gtIg = np.asarray([g["_ignore"] for g in gt])
+        dtm = np.zeros((T, D), np.int64) - 1
+        gtm = np.zeros((T, G), np.int64) - 1
+        dtIg = np.zeros((T, D), bool)
+        for t, thr in enumerate(IOU_THRESHS):
+            for di in range(D):
+                iou = min(thr, 1 - 1e-10)
+                m = -1
+                for gj in range(G):
+                    # already matched (by a better det) and not crowd
+                    if gtm[t, gj] >= 0 and not gt[gj]["iscrowd"]:
+                        continue
+                    # holding an unignored match; stop at ignored gt
+                    if m > -1 and gtIg[m] == 0 and gtIg[gj] == 1:
+                        break
+                    if ious[di, gj] < iou:
+                        continue
+                    iou = ious[di, gj]
+                    m = gj
+                if m == -1:
+                    continue
+                dtIg[t, di] = bool(gtIg[m])
+                dtm[t, di] = m
+                gtm[t, m] = di
+        # unmatched dets with out-of-range area are ignored
+        if self.iou_type == "bbox":
+            d_area = np.asarray([d["bbox"][2] * d["bbox"][3]
+                                 for d in dt])
+        else:
+            d_area = np.asarray([float(d["mask"].astype(bool).sum())
+                                 for d in dt])
+        out = (d_area < lo) | (d_area > hi)
+        dtIg = dtIg | ((dtm < 0) & out[None, :])
+        return {
+            "scores": np.asarray([d["score"] for d in dt]),
+            "dtm": dtm, "dtIg": dtIg,
+            "npig": int((gtIg == 0).sum()),
+        }
+
+    def accumulate(self):
+        cats = sorted({g["category_id"]
+                       for gs in self.gts.values() for g in gs}
+                      | {d["category_id"]
+                         for ds in self.dts.values() for d in ds})
+        iids = sorted(set(self.gts) | set(self.dts))
+        T = len(IOU_THRESHS)
+        results = {}
+        for rname, (lo, hi) in AREA_RANGES.items():
+            # precision[t, cat] = AP at threshold t, or -1
+            ap = np.zeros((T, len(cats))) - 1.0
+            ar = np.zeros((T, len(cats))) - 1.0
+            for ci, cat in enumerate(cats):
+                evs = [self._evaluate_img(iid, cat, lo, hi)
+                       for iid in iids]
+                evs = [e for e in evs if e is not None]
+                if not evs:
+                    continue
+                npig = sum(e["npig"] for e in evs)
+                if npig == 0:
+                    continue
+                scores = np.concatenate([e["scores"] for e in evs])
+                order = np.argsort(-scores, kind="mergesort")
+                dtm = np.concatenate([e["dtm"] for e in evs],
+                                     axis=1)[:, order]
+                dtIg = np.concatenate([e["dtIg"] for e in evs],
+                                      axis=1)[:, order]
+                for t in range(T):
+                    tps = (dtm[t] >= 0) & ~dtIg[t]
+                    fps = (dtm[t] < 0) & ~dtIg[t]
+                    tp = np.cumsum(tps).astype(float)
+                    fp = np.cumsum(fps).astype(float)
+                    nd = len(tp)
+                    rc = tp / npig
+                    pr = tp / (fp + tp + np.spacing(1))
+                    ar[t, ci] = rc[-1] if nd else 0.0
+                    q = np.zeros(len(RECALL_POINTS))
+                    for i in range(nd - 1, 0, -1):
+                        if pr[i] > pr[i - 1]:
+                            pr[i - 1] = pr[i]
+                    inds = np.searchsorted(rc, RECALL_POINTS,
+                                           side="left")
+                    for ri, pi in enumerate(inds):
+                        if pi < nd:
+                            q[ri] = pr[pi]
+                    ap[t, ci] = q.mean()
+            valid = ap > -1
+            results[f"AP_{rname}"] = (float(ap[valid].mean())
+                                      if valid.any() else -1.0)
+            arv = ar > -1
+            results[f"AR_{rname}"] = (float(ar[arv].mean())
+                                      if arv.any() else -1.0)
+            if rname == "all":
+                results["AP"] = results["AP_all"]
+                a50 = ap[0][ap[0] > -1]
+                a75 = ap[5][ap[5] > -1]
+                results["AP50"] = (float(a50.mean()) if len(a50)
+                                   else -1.0)
+                results["AP75"] = (float(a75.mean()) if len(a75)
+                                   else -1.0)
+        return results

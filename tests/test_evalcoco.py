@@ -174,8 +174,8 @@ def test_run_evaluation_with_stub_predictor():
 
 def test_native_greedy_match_matches_python():
     """The C++ matcher (maskops.cc greedy_match) must reproduce the
-    python greedy loop exactly — crowd IoF columns, saturation, and the
-    no-downgrade-to-crowd rule included."""
+    python greedy loop exactly — crowd IoF columns, per-range ignore
+    flags, the break-at-ignored rule, and crowd rematching included."""
     from eksml_tpu.evalcoco.cocoeval import IOU_THRESHS
     from eksml_tpu.evalcoco.native import greedy_match_native
 
@@ -185,22 +185,25 @@ def test_native_greedy_match_matches_python():
         G = int(rng.randint(1, 9))
         ious = rng.rand(D, G)
         crowd = (rng.rand(G) < 0.3).astype(np.int64)
-        g_order = np.argsort(crowd, kind="mergesort")
-        native = greedy_match_native(ious, crowd, g_order, IOU_THRESHS)
+        # ignore ⊇ crowd (area-range ignores add to crowd ignores)
+        ignore = crowd.astype(bool) | (rng.rand(G) < 0.3)
+        g_order = np.argsort(ignore, kind="mergesort")
+        native = greedy_match_native(ious, crowd, ignore, g_order,
+                                     IOU_THRESHS)
         if native is None:
             pytest.skip("native maskops not built on this host")
         T = len(IOU_THRESHS)
         dt_match = np.zeros((T, D), np.int64) - 1
-        dt_crowd = np.zeros((T, D), bool)
+        dt_ignore = np.zeros((T, D), bool)
         gt_match = np.zeros((T, G), bool)
         for t, thr in enumerate(IOU_THRESHS):
             for di in range(D):
-                best = thr - 1e-10
+                best = min(thr, 1 - 1e-10)
                 best_g = -1
                 for gj in g_order:
                     if gt_match[t, gj] and not crowd[gj]:
                         continue
-                    if best_g > -1 and not crowd[best_g] and crowd[gj]:
+                    if best_g > -1 and not ignore[best_g] and ignore[gj]:
                         break
                     if ious[di, gj] < best:
                         continue
@@ -208,12 +211,13 @@ def test_native_greedy_match_matches_python():
                     best_g = gj
                 if best_g >= 0:
                     dt_match[t, di] = best_g
-                    dt_crowd[t, di] = bool(crowd[best_g])
+                    dt_ignore[t, di] = bool(ignore[best_g])
                     if not crowd[best_g]:
                         gt_match[t, best_g] = True
         np.testing.assert_array_equal(native[0], dt_match,
                                       err_msg=f"trial {trial}")
-        np.testing.assert_array_equal(native[1], dt_crowd)
+        np.testing.assert_array_equal(native[1], dt_ignore,
+                                      err_msg=f"trial {trial}")
         np.testing.assert_array_equal(native[2], gt_match)
 
 

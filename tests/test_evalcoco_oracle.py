@@ -1,0 +1,217 @@
+"""Cross-validation of eksml_tpu/evalcoco against an independent
+literal transcription of official pycocotools semantics
+(tests/coco_oracle.py).
+
+pycocotools itself cannot be installed here (zero egress), so the
+oracle plays the role VERDICT r4 #2 assigned to committed pycocotools
+goldens: a second, shared-nothing implementation whose every branch
+was written directly from the official algorithm, compared on
+adversarial fixtures covering the notoriously subtle cases — crowd-as
+-ignore, area-range rematching, score ties, boundary areas (exactly
+32²), dets with no gt, gt with no dets.  Reference mechanism:
+pycocotools C extension, /root/reference/container/Dockerfile:12.
+"""
+
+import numpy as np
+import pytest
+
+from coco_oracle import OracleEval
+from eksml_tpu.evalcoco.cocoeval import COCOEvaluator
+
+KEYS = ["AP", "AP50", "AP75", "AP_small", "AP_medium", "AP_large",
+        "AR_all", "AR_small", "AR_medium", "AR_large"]
+
+
+def _compare(ev, orc, keys=KEYS, tol=1e-9):
+    r1 = ev.accumulate()
+    r2 = orc.accumulate()
+    for k in keys:
+        assert r1.get(k, -1.0) == pytest.approx(r2.get(k, -1.0),
+                                                abs=tol), (
+            f"{k}: evaluator {r1.get(k)} vs oracle {r2.get(k)}")
+
+
+def _bbox_fixture(seed, n_imgs=4, n_classes=3):
+    """Adversarial random scene: crowds (~20%), boundary areas
+    (exactly 32² with probability 1/4), coarse scores (ties), noise
+    dets, empty images."""
+    rng = np.random.RandomState(seed)
+    ev_records, o_gts, o_dts, det_calls = [], {}, {}, []
+    for iid in range(n_imgs):
+        n_gt = rng.randint(0, 7)
+        boxes, classes, crowd, areas, gts = [], [], [], [], []
+        for _ in range(n_gt):
+            x1, y1 = rng.rand(2) * 200
+            choice = rng.randint(4)
+            if choice == 0:
+                w = h = 32.0            # area exactly the small/medium bound
+            elif choice == 1:
+                w, h = rng.rand(2) * 20 + 4
+            elif choice == 2:
+                w, h = rng.rand(2) * 60 + 30
+            else:
+                w, h = rng.rand(2) * 150 + 90
+            c = rng.randint(n_classes)
+            cr = int(rng.rand() < 0.2)
+            boxes.append([x1, y1, x1 + w, y1 + h])
+            classes.append(c)
+            crowd.append(cr)
+            areas.append(w * h)
+            gts.append({"bbox": [x1, y1, w, h], "area": w * h,
+                        "iscrowd": cr, "category_id": c})
+        ev_records.append({
+            "image_id": iid,
+            "boxes": np.asarray(boxes, np.float64).reshape(-1, 4),
+            "classes": np.asarray(classes, np.int64),
+            "iscrowd": np.asarray(crowd, np.int64),
+            "areas": np.asarray(areas, np.float64)})
+        o_gts[iid] = gts
+        dts, db, dsc, dcl = [], [], [], []
+        for g, c in zip(boxes, classes):
+            if rng.rand() < 0.85:
+                jit = rng.randn(4) * rng.choice([1.0, 4.0, 10.0])
+                b = np.asarray(g) + jit
+                b[2] = max(b[2], b[0] + 1)
+                b[3] = max(b[3], b[1] + 1)
+                db.append(b)
+                dsc.append(round(float(rng.rand()), 2))  # coarse → ties
+                dcl.append(c)
+        for _ in range(rng.randint(0, 5)):
+            x1, y1 = rng.rand(2) * 200
+            w, h = rng.rand(2) * 80 + 2
+            db.append(np.asarray([x1, y1, x1 + w, y1 + h]))
+            dsc.append(round(float(rng.rand()), 2))
+            dcl.append(rng.randint(n_classes))
+        for b, s, c in zip(db, dsc, dcl):
+            dts.append({"bbox": [b[0], b[1], b[2] - b[0], b[3] - b[1]],
+                        "score": s, "category_id": int(c)})
+        o_dts[iid] = dts
+        det_calls.append((iid, np.asarray(db, np.float64).reshape(-1, 4),
+                          np.asarray(dsc), np.asarray(dcl, np.int64)))
+    return ev_records, o_gts, o_dts, det_calls
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_bbox_matches_oracle(seed):
+    recs, o_gts, o_dts, det_calls = _bbox_fixture(seed)
+    ev = COCOEvaluator(recs, num_classes=3, iou_type="bbox")
+    orc = OracleEval("bbox")
+    for iid, g in o_gts.items():
+        orc.add_gt(iid, g)
+    for iid, d in o_dts.items():
+        orc.add_dt(iid, d)
+    for iid, b, s, c in det_calls:
+        if len(b):
+            ev.add_detections(iid, b, s, c)
+    _compare(ev, orc)
+
+
+def _rect_mask(h, w, y1, x1, y2, x2):
+    m = np.zeros((h, w), np.uint8)
+    m[int(y1):int(y2), int(x1):int(x2)] = 1
+    return m
+
+
+def test_segm_matches_oracle_with_crowd():
+    """Mask IoU path: crowd mask absorbing two detections (IoF), one
+    clean match, one miss — segm det area is the MASK area, not the
+    box area (a too-large sloppy box must not change range bucketing)."""
+    H = W = 96
+    gt_masks = [_rect_mask(H, W, 10, 10, 40, 40),     # clean, area 900
+                _rect_mask(H, W, 50, 50, 90, 90)]     # crowd, area 1600
+    recs = [{"image_id": 0,
+             "boxes": np.asarray([[10, 10, 40, 40], [50, 50, 90, 90]],
+                                 np.float64),
+             "classes": np.asarray([0, 0], np.int64),
+             "iscrowd": np.asarray([0, 1], np.int64),
+             "areas": np.asarray([900.0, 1600.0]),
+             "masks": gt_masks}]
+    det_masks = [_rect_mask(H, W, 12, 12, 40, 40),    # good match
+                 _rect_mask(H, W, 52, 52, 80, 80),    # inside crowd
+                 _rect_mask(H, W, 60, 60, 88, 88),    # also inside crowd
+                 _rect_mask(H, W, 0, 60, 20, 90)]     # miss
+    # boxes deliberately sloppy: segm area must come from the masks
+    det_boxes = np.asarray([[0, 0, 95, 95]] * 4, np.float64)
+    scores = np.asarray([0.9, 0.8, 0.7, 0.6])
+    classes = np.zeros(4, np.int64)
+
+    ev = COCOEvaluator(recs, num_classes=1, iou_type="segm")
+    ev.add_detections(0, det_boxes, scores, classes, masks=det_masks)
+    orc = OracleEval("segm")
+    orc.add_gt(0, [{"bbox": [10, 10, 30, 30], "area": 900.0,
+                    "iscrowd": 0, "category_id": 0,
+                    "mask": gt_masks[0]},
+                   {"bbox": [50, 50, 40, 40], "area": 1600.0,
+                    "iscrowd": 1, "category_id": 0,
+                    "mask": gt_masks[1]}])
+    orc.add_dt(0, [{"bbox": [0, 0, 95, 95], "score": float(s),
+                    "category_id": 0, "mask": m}
+                   for s, m in zip(scores, det_masks)])
+    _compare(ev, orc)
+
+
+def test_tie_scores_and_boundary_area_deterministic():
+    """Hand-built worst case: two dets with IDENTICAL scores competing
+    for one gt (stable-sort order decides), plus a det whose best
+    overlap is an out-of-range gt while an in-range gt is available —
+    the per-range rematch case a match-once evaluator gets wrong."""
+    recs = [{"image_id": 0,
+             "boxes": np.asarray([[0, 0, 32, 32],        # small-bound gt
+                                  [40, 40, 140, 140]],   # large gt
+                                 np.float64),
+             "classes": np.asarray([0, 0], np.int64),
+             "iscrowd": np.asarray([0, 0], np.int64),
+             "areas": np.asarray([1024.0, 10000.0])}]
+    # det 0/1: same score, both overlap gt0; det 2 overlaps BOTH gts,
+    # better IoU on the (medium-ignored) large gt
+    det_boxes = np.asarray([[0, 0, 30, 32],
+                            [2, 0, 32, 32],
+                            [30, 30, 140, 140]], np.float64)
+    scores = np.asarray([0.5, 0.5, 0.4])
+    classes = np.zeros(3, np.int64)
+
+    ev = COCOEvaluator(recs, num_classes=1, iou_type="bbox")
+    ev.add_detections(0, det_boxes, scores, classes)
+    orc = OracleEval("bbox")
+    orc.add_gt(0, [{"bbox": [0, 0, 32, 32], "area": 1024.0,
+                    "iscrowd": 0, "category_id": 0},
+                   {"bbox": [40, 40, 100, 100], "area": 10000.0,
+                    "iscrowd": 0, "category_id": 0}])
+    orc.add_dt(0, [{"bbox": [0, 0, 30, 32], "score": 0.5,
+                    "category_id": 0},
+                   {"bbox": [2, 0, 30, 32], "score": 0.5,
+                    "category_id": 0},
+                   {"bbox": [30, 30, 110, 110], "score": 0.4,
+                    "category_id": 0}])
+    _compare(ev, orc)
+    # area exactly 32² sits in BOTH small and medium (inclusive bounds)
+    r = ev.accumulate()
+    assert r["AP_small"] > -1.0 and r["AP_medium"] > -1.0
+
+
+def test_dets_without_gt_and_gt_without_dets():
+    recs = [{"image_id": 0,
+             "boxes": np.asarray([[5, 5, 50, 50]], np.float64),
+             "classes": np.asarray([1], np.int64),
+             "iscrowd": np.asarray([0], np.int64),
+             "areas": np.asarray([2025.0])},
+            {"image_id": 1, "boxes": np.zeros((0, 4)),
+             "classes": np.zeros((0,), np.int64),
+             "iscrowd": np.zeros((0,), np.int64),
+             "areas": np.zeros((0,))}]
+    ev = COCOEvaluator(recs, num_classes=2, iou_type="bbox")
+    # class-0 dets have NO gt anywhere; class-1 gt has no dets on
+    # image 0 but a spurious det on empty image 1
+    ev.add_detections(0, np.asarray([[60, 60, 90, 90]], np.float64),
+                      np.asarray([0.9]), np.asarray([0], np.int64))
+    ev.add_detections(1, np.asarray([[10, 10, 30, 30]], np.float64),
+                      np.asarray([0.8]), np.asarray([1], np.int64))
+    orc = OracleEval("bbox")
+    orc.add_gt(0, [{"bbox": [5, 5, 45, 45], "area": 2025.0,
+                    "iscrowd": 0, "category_id": 1}])
+    orc.add_gt(1, [])
+    orc.add_dt(0, [{"bbox": [60, 60, 30, 30], "score": 0.9,
+                    "category_id": 0}])
+    orc.add_dt(1, [{"bbox": [10, 10, 20, 20], "score": 0.8,
+                    "category_id": 1}])
+    _compare(ev, orc)
