@@ -161,7 +161,9 @@ def _preflight_applies(args) -> bool:
         return False
     tunnel_configured = any(os.environ.get(k) for k in (
         "EKSML_TUNNEL_HOST", "EKSML_TUNNEL_PORT", "PROBE_PORT"))
-    return "axon" in platforms or tunnel_configured
+    return ("axon" in platforms
+            or (args.platform or "").lower() == "axon"
+            or tunnel_configured)
 
 
 def _init_devices(retries: int, backoff: float, attempt_timeout: float):

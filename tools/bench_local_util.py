@@ -63,9 +63,22 @@ def stamp(payload: dict, out: str) -> None:
     tmp = out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(rec, f)
-    import os
-
     os.replace(tmp, out)
+
+
+def rotate(path: str, max_age: float = DEFAULT_MAX_AGE) -> bool:
+    """Set aside ``path`` when it is stale: rename (never delete) to
+    ``<path minus .json>.stale.<ts>.json``.  Returns True when the file
+    is absent-or-fresh afterwards.  One implementation for the session
+    -start guards in bench_supervisor.sh AND tpu_harvest.sh (code
+    review r5: the block had been copy-pasted between them)."""
+    if not os.path.exists(path):
+        return True
+    if is_fresh(path, max_age):
+        return True
+    base = path[:-5] if path.endswith(".json") else path
+    os.replace(path, f"{base}.stale.{utcnow().replace(':', '')}.json")
+    return True
 
 
 def main(argv=None) -> int:
@@ -74,6 +87,9 @@ def main(argv=None) -> int:
     c = sub.add_parser("check")
     c.add_argument("--path", default="BENCH_LOCAL.json")
     c.add_argument("--max-age", type=float, default=DEFAULT_MAX_AGE)
+    r = sub.add_parser("rotate")
+    r.add_argument("--path", default="BENCH_LOCAL.json")
+    r.add_argument("--max-age", type=float, default=DEFAULT_MAX_AGE)
     s = sub.add_parser("stamp")
     s.add_argument("--out", required=True)
     s.add_argument("--from-file", default=None)
@@ -82,6 +98,8 @@ def main(argv=None) -> int:
 
     if args.cmd == "check":
         return 0 if is_fresh(args.path, args.max_age) else 1
+    if args.cmd == "rotate":
+        return 0 if rotate(args.path, args.max_age) else 1
     if args.from_file:
         with open(args.from_file) as f:
             payload = json.load(f)

@@ -96,9 +96,22 @@ sys.exit(0 if ok else 1)' "$out"
         # stamp banked_at so tools/bank_round.py --since can tell this
         # session's number from a stale cross-round leftover; the util
         # writes tmp+mv so pollers never see a partial file
-        python tools/bench_local_util.py stamp --out BENCH_LOCAL.json \
-            "$out"
-        echo "[loop] success $(date -u +%H:%M:%S)" >> bench_loop.log
+        if python tools/bench_local_util.py stamp \
+            --out BENCH_LOCAL.json "$out"; then
+            echo "[loop] success $(date -u +%H:%M:%S)" >> bench_loop.log
+            exit 0
+        fi
+        # stamp failed (ENOSPC, env breakage): do NOT claim success —
+        # the supervisor polls for BENCH_LOCAL.json and would wait
+        # forever on a silent miss.  The fallback must still carry a
+        # banked_at (shell-injected, same TS_FMT as bench.py) or the
+        # rotate guards would classify this genuine hardware evidence
+        # as stale and set it aside (code review r5).
+        echo "[loop] STAMP FAILED; raw fallback write" \
+             "$(date -u +%H:%M:%S)" >> bench_loop.log
+        ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+        printf '%s' "$out" \
+            | sed "s/}\$/, \"banked_at\": \"$ts\"}/" > BENCH_LOCAL.json
         exit 0
     fi
     if grep -q "pre-flight" <<< "$out"; then

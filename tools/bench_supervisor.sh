@@ -26,11 +26,11 @@ PROBE_PORT=${EKSML_TUNNEL_PORT:-${PROBE_PORT:-8103}}
 # restart within 2h of the session's own success keeps it; an older
 # one is re-measured from the warm compile cache), and RENAMED, never
 # deleted — evidence is preserved either way.
-if [ -e BENCH_LOCAL.json ] \
-    && ! python tools/bench_local_util.py check 2>/dev/null; then
-    echo "[supervisor] $(date -u +%H:%M:%S) setting aside stale" \
-         "BENCH_LOCAL.json" >> "$LOG"
-    mv BENCH_LOCAL.json "BENCH_LOCAL.stale.$(date -u +%Y%m%dT%H%M%SZ).json"
+if [ -e BENCH_LOCAL.json ]; then
+    python tools/bench_local_util.py rotate 2>/dev/null || true
+    [ -e BENCH_LOCAL.json ] \
+        || echo "[supervisor] $(date -u +%H:%M:%S) set aside stale" \
+                "BENCH_LOCAL.json" >> "$LOG"
 fi
 
 probe() {  # 0 = something is listening on the tunnel port

@@ -136,10 +136,9 @@ if d.get("bbox_AP50", 0) < old.get("bbox_AP50", 0):
 # not deleted).  The wait below then resumes: with BENCH_LOCAL gone the
 # supervisor keeps the retry loop hunting, and the warm compile cache
 # makes a re-landing cheap.
-if [ -e BENCH_LOCAL.json ] \
-    && ! python tools/bench_local_util.py check 2>/dev/null; then
-    say "setting aside stale BENCH_LOCAL.json"
-    mv BENCH_LOCAL.json "BENCH_LOCAL.stale.$(date -u +%Y%m%dT%H%M%SZ).json"
+if [ -e BENCH_LOCAL.json ]; then
+    python tools/bench_local_util.py rotate 2>/dev/null || true
+    [ -e BENCH_LOCAL.json ] || say "set aside stale BENCH_LOCAL.json"
 fi
 
 if [ "$WAIT_HEADLINE" = "1" ]; then
@@ -208,9 +207,13 @@ sys.exit(0 if ok else 1)'; then
         # stamp banked_at (same contract as the loop's write): an
         # unstamped BENCH_LOCAL fails bank_round's --since filter and
         # the supervisor/harvest stale checks (code review r5)
-        python tools/bench_local_util.py stamp --out BENCH_LOCAL.json \
-            --from-file artifacts/bench_ladder_retry.json
-        say "headline point upgraded into BENCH_LOCAL.json"
+        if python tools/bench_local_util.py stamp \
+            --out BENCH_LOCAL.json \
+            --from-file artifacts/bench_ladder_retry.json; then
+            say "headline point upgraded into BENCH_LOCAL.json"
+        else
+            say "STAMP FAILED; keeping banked ladder result"
+        fi
     else
         say "headline retry did not land; keeping banked ladder result"
     fi
