@@ -237,9 +237,11 @@ def _kernel(out_size: int, sampling: int, num_levels: int, align: int,
     from jax.experimental.pallas import tpu as pltpu
 
     feat_refs = refs[:num_levels]          # HBM [B, Hp, Wp, C] each
-    out_ref = refs[num_levels]             # VMEM [1, out, out, C]
+    out_ref = refs[num_levels]             # HBM [N, out, out_pad, C]
     tiles_ref = refs[num_levels + 1]       # VMEM scratch [2, T, T, C]
     sems = refs[num_levels + 2]            # DMA semaphores (2,)
+    res_ref = refs[num_levels + 3]         # VMEM scratch [1, out, pad, C]
+    out_sem = refs[num_levels + 4]         # DMA semaphore
 
     r = pl.program_id(0)
     n = pl.num_programs(0)
@@ -307,7 +309,24 @@ def _kernel(out_size: int, sampling: int, num_levels: int, align: int,
     sampled = sampled.transpose(0, 2, 1)            # [S, S, C]
     pooled = sampled.reshape(out_size, sampling, out_size, sampling,
                              c).mean(axis=(1, 3))
-    out_ref[0] = pooled.astype(out_ref.dtype)
+    # The output buffer is pinned to HBM and written by explicit DMA
+    # (~100 KB/ROI, negligible next to the matmuls).  A windowed VMEM
+    # out_spec let XLA choose the buffer's home — and on hardware it
+    # greedily packed pallas outputs into scoped vmem until the
+    # kernel's own stack allocation failed, at ANY limit (16 MiB
+    # default and the raised 32 MiB both died with the same ~156 KiB
+    # overshoot, round 5).  Explicit HBM removes the choice.
+    # The DMA must move full tile-aligned extents: the buffer's W dim
+    # is padded to the sublane tile (7→8, 14→16) and the pad columns
+    # ride along (sliced off at the XLA level after the call).
+    pad_w = res_ref.shape[2] - out_size
+    if pad_w:
+        pooled = jnp.pad(pooled, ((0, 0), (0, pad_w), (0, 0)))
+    res_ref[0] = pooled.astype(res_ref.dtype)
+    copy = pltpu.make_async_copy(res_ref, out_ref.at[pl.ds(r, 1)],
+                                 out_sem)
+    copy.start()
+    copy.wait()
 
 
 def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
@@ -460,9 +479,12 @@ _VMEM_STACK_BUDGET = 13 * 2 ** 20   # leave ~3 MiB for spills/semaphores
 def _roi_chunk(n_total: int, out_size: int, c: int, dtype,
                scratch_bytes: int) -> int:
     """Largest divisor of ``n_total`` whose per-call stack estimate
-    (chunk's output + kernel scratch) fits the scoped-vmem budget."""
+    (chunk's output + kernel scratch) fits the scoped-vmem budget.
+    The per-ROI size uses the TILED output layout (W padded to the
+    sublane tile, 7→8 / 14→16) — the buffer XLA would actually pack."""
     esize = jnp.dtype(dtype).itemsize
-    per_roi = out_size * out_size * c * esize
+    out_pad = out_size + (-out_size % 8)
+    per_roi = out_size * out_pad * c * esize
     room = max(_VMEM_STACK_BUDGET - scratch_bytes, per_roi)
     bound = max(room // per_roi, 1)
     if n_total <= bound:
@@ -485,27 +507,39 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
                              align)
 
     esize = jnp.dtype(feats[0].dtype).itemsize
-    scratch_bytes = 2 * TILE * TILE * c * esize
+    out_pad = out_size + (-out_size % 8)
+    # tile double-buffer + the per-ROI result staging block
+    scratch_bytes = (2 * TILE * TILE + out_size * out_pad) * c * esize
     chunk = _roi_chunk(b * n, out_size, c, feats[0].dtype, scratch_bytes)
 
     def call(chunk_scalars, n_rois):
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=8,
             grid=(n_rois,),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
-            out_specs=pl.BlockSpec((1, out_size, out_size, c),
-                                   lambda r, *_: (r, 0, 0, 0),
-                                   memory_space=pltpu.VMEM),
+            # unwindowed HBM refs: Mosaic DMAs explicitly, and the
+            # buffers stay off the kernel's scoped-vmem stack UNLESS
+            # XLA elects to place them there — chunking bounds each
+            # call's output so that even a packed chunk fits the
+            # raised 32 MiB limit alongside the tile scratch
+            in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)] * num_levels,
+            out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
             scratch_shapes=[
                 pltpu.VMEM((2, TILE, TILE, c), feats[0].dtype),
                 pltpu.SemaphoreType.DMA((2,)),
+                pltpu.VMEM((1, out_size, out_pad, c), feats[0].dtype),
+                pltpu.SemaphoreType.DMA(()),
             ],
         )
+        # no output coloring here: with ROI chunking bounding the
+        # output and the 32 MiB scoped limit, worst-case packing
+        # (chunk output + feats + scratch) stays well under the limit,
+        # and leaving XLA free to keep small outputs vmem-resident is
+        # measurably faster (18.8 vs 16.4 img/s at 512px/b4)
         return pl.pallas_call(
             kern,
             grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((n_rois, out_size, out_size, c),
-                                           feats[0].dtype),
+            out_shape=jax.ShapeDtypeStruct(
+                (n_rois, out_size, out_pad, c), feats[0].dtype),
             interpret=interpret,
         )(*chunk_scalars, *feats)
 
@@ -515,7 +549,55 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
         out = jnp.concatenate([
             call(tuple(s[i:i + chunk] for s in scalars), chunk)
             for i in range(0, b * n, chunk)], axis=0)
-    return out.reshape(b, n, out_size, out_size, c)
+    return out[:, :, :out_size, :].reshape(b, n, out_size, out_size, c)
+
+
+def _hbm_out(shape, dtype):
+    """out_shape entry that pins the output buffer to HBM.  A MemoryRef
+    out_shape flows an annotated aval into the pallas_call params (the
+    lowering reads them into the custom call's output_memory_colors)
+    while the primitive's abstract eval strips the annotation from the
+    OUTWARD aval — so placement is constrained without annotated avals
+    leaking into downstream jax ops (which reject them).  This is the
+    output-side twin of with_memory_space_constraint, and together
+    they close the round-5 hardware failure: XLA packing pallas
+    outputs/aliased seeds into scoped vmem until the Mosaic kernel
+    stack overflowed (at the 16 MiB default and 32 MiB alike)."""
+    from jax._src import core as jax_core
+    from jax._src.pallas.core import MemoryRef
+    from jax._src.pallas.mosaic.core import MemorySpace
+
+    return MemoryRef(jax_core.ShapedArray(shape, dtype),
+                     MemorySpace.HBM)
+
+
+def _to_hbm(x):
+    """Materialize ``x`` in an HBM-pinned buffer via a whole-buffer DMA
+    copy kernel.  Output coloring is the one placement constraint this
+    XLA revision demonstrably honors (S(1) vanished from colored
+    outputs on hardware); INPUT colors on must-alias operands are
+    ignored when the operand is a vmem-placed fusion (a jnp.zeros
+    broadcast), which is exactly how the backward's aliased gradient
+    accumulators ended up on the Mosaic stack.  Copying through this
+    kernel launders the buffer into HBM so everything downstream that
+    aliases it inherits the placement.  Stack-safe: the kernel has no
+    vmem scratch, so even a vmem-placed INPUT (≤ the scoped limit by
+    definition) still compiles."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def k(in_ref, out_ref, sem):
+        copy = pltpu.make_async_copy(in_ref, out_ref, sem)
+        copy.start()
+        copy.wait()
+
+    return pl.pallas_call(
+        k,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+        out_shape=_hbm_out(x.shape, x.dtype),
+    )(x)
 
 
 def _pallas_backward(feats, rois, g, strides, out_size, sampling,
@@ -554,25 +636,82 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             in_specs=[pl.BlockSpec((1, out_size, out_size, c),
                                    lambda r, *_: (r, 0, 0, 0),
                                    memory_space=pltpu.VMEM)]
-            + [pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
-            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * num_levels,
+            + [pl.BlockSpec(memory_space=pltpu.HBM)] * num_levels,
+            # the f32 feature-grad accumulators are the BIG buffers
+            # ([B,128,128,256] = 16.8 MiB at 512px/b4): on hardware
+            # XLA packed them into scoped vmem as S(1) tuple elements
+            # and broke the compile at any limit (round-5 convergence
+            # run).  BlockSpec memory_space alone does NOT constrain
+            # XLA's buffer placement — the with_memory_space_constraint
+            # on the aliased inputs below is what pins them to HBM.
+            out_specs=[pl.BlockSpec(memory_space=pltpu.HBM)] * num_levels,
             scratch_shapes=[
                 pltpu.VMEM((TILE, TILE, c), jnp.float32),
                 pltpu.SemaphoreType.DMA(()),
             ],
         )
+        out_shape = tuple(
+            _hbm_out(f.shape, jnp.float32) if pinned[i]
+            else jax.ShapeDtypeStruct(f.shape, jnp.float32)
+            for i, f in enumerate(padded))
         return pl.pallas_call(
             kern,
             grid_spec=grid_spec,
-            out_shape=tuple(jax.ShapeDtypeStruct(f.shape, jnp.float32)
-                            for f in padded),
+            out_shape=out_shape,
             # accumulator i (flat arg index 8 scalars + 1 g + i) owns
             # output buffer i: the kernel RMWs it through the out refs
             input_output_aliases={9 + i: i for i in range(num_levels)},
             interpret=interpret,
         )(*chunk_scalars, g_chunk, *accs)
 
+    # Pin the LARGEST accumulator levels to HBM (colored out avals +
+    # laundered zero seeds) and leave the rest eligible for XLA's
+    # vmem packing.  Both directions matter, measured on v5e:
+    # vmem-resident accumulators make the kernel's per-ROI RMW tiles
+    # vmem-local (pinning everything costs ~12% step time at
+    # 512px/b4), while unpinned-large is the round-5 compile failure
+    # (XLA vmem-placed the zeros broadcasts and the aliased chain
+    # dragged 29 MiB onto the Mosaic stack).  Pin until the unpinned
+    # sum ≤ 24 MiB: unpinned + tile scratch + blocks then stays ≥3 MiB
+    # clear of the 32 MiB scoped limit even if XLA packs every
+    # unpinned buffer.
+    sizes = [int(np.prod(f.shape)) * 4 for f in padded]
+    pinned = [False] * num_levels
+    if not interpret and os.environ.get("EKSML_BWD_PIN", "1") != "0":
+        limit = _SCOPED_VMEM_KIB * 1024
+        if jnp.dtype(feats[0].dtype) == jnp.float32:
+            # f32 graphs carry double-size temps everywhere and the
+            # packer runs much hotter (the round-5 f32 convergence
+            # compile failed at every looser setting tried on
+            # hardware): pin largest-first until the unpinned sum is
+            # small — compile safety over RMW locality
+            order = sorted(range(num_levels), key=lambda i: -sizes[i])
+            remaining = sum(sizes)
+            for i in order:
+                if remaining <= 12 * 2 ** 20:
+                    break
+                pinned[i] = True
+                remaining -= sizes[i]
+        else:
+            # bf16 production path: walk fine→coarse keeping levels
+            # vmem-eligible — level 0 carries most ROIs (FPN sends
+            # small objects to the finest level) and its residency
+            # buys the most RMW locality (17.9 vs 16.3 img/s at
+            # 512px/b4 on v5e); a level that cannot fit the scoped
+            # limit at all is left unpinned for free
+            kept = 0
+            budget = min(18 * 2 ** 20, limit - 14 * 2 ** 20)
+            for i in range(num_levels):
+                if sizes[i] >= limit:
+                    continue
+                if kept + sizes[i] <= budget:
+                    kept += sizes[i]
+                else:
+                    pinned[i] = True
+
     outs = tuple(jnp.zeros(f.shape, jnp.float32) for f in padded)
+    outs = tuple(_to_hbm(o) if pinned[i] else o
+                 for i, o in enumerate(outs))
     for i in range(0, b * n, chunk):
         outs = call(tuple(s[i:i + chunk] for s in scalars),
                     g_flat[i:i + chunk], outs, chunk)

@@ -12,50 +12,7 @@ step → checkpoint → COCO evaluation, no synthetic shortcuts.
 import json
 import os
 
-import numpy as np
 import pytest
-
-
-@pytest.fixture()
-def mini_coco(tmp_path):
-    from PIL import Image
-
-    rng = np.random.RandomState(0)
-    base = tmp_path / "data"
-    cats = [{"id": 1, "name": "person"}, {"id": 18, "name": "dog"}]
-    for split, n_img in (("train2017", 6), ("val2017", 2)):
-        (base / split).mkdir(parents=True)
-        images, anns = [], []
-        aid = 1
-        for i in range(n_img):
-            h, w = int(rng.randint(60, 100)), int(rng.randint(60, 100))
-            name = f"{split}_{i:03d}.jpg"
-            Image.fromarray(
-                rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
-            ).save(base / split / name, quality=90)
-            iid = 1000 + i if split == "train2017" else 2000 + i
-            images.append({"id": iid, "file_name": name,
-                           "height": h, "width": w})
-            for _ in range(int(rng.randint(1, 4))):
-                bw, bh = rng.randint(10, 30, 2)
-                x = int(rng.randint(0, w - bw))
-                y = int(rng.randint(0, h - bh))
-                anns.append({
-                    "id": aid, "image_id": iid,
-                    "category_id": int(rng.choice([1, 18])),
-                    "bbox": [x, y, int(bw), int(bh)],
-                    "iscrowd": 0, "area": int(bw * bh),
-                    "segmentation": [[x, y, x + int(bw), y,
-                                      x + int(bw), y + int(bh),
-                                      x, y + int(bh)]],
-                })
-                aid += 1
-        (base / "annotations").mkdir(exist_ok=True)
-        with open(base / "annotations" / f"instances_{split}.json",
-                  "w") as f:
-            json.dump({"images": images, "annotations": anns,
-                       "categories": cats}, f)
-    return str(base)
 
 
 @pytest.mark.slow

@@ -1,0 +1,112 @@
+"""Execute the inference notebook in CI (VERDICT r4 #5 / component
+#29): train a tiny checkpoint on the mini-COCO fixture, then run
+container-viz/notebooks/mask-rcnn-eksml-tpu-viz.ipynb cell-by-cell
+with nbclient against it — the full user path the reference's viz
+notebooks cover interactively (latest checkpoint discovery → config →
+OfflinePredictor → predict_image → draw_final_outputs), reference
+container-viz/notebooks/mask-rcnn-tensorpack-viz.ipynb cells 7-27 and
+the optimized variant's explicit output handling (cells 11, 16-18).
+
+The notebook parameterizes through the SAME env contract the charts
+use: FS_ROOT (filesystem root with <run>/train_log/maskrcnn and data/)
+plus EKSML_NB_CONFIG (KEY=VALUE model-shape overrides ≙ extra_config)
+— no test-only forks of the notebook source.
+"""
+
+import json
+import os
+
+import pytest
+
+NB_PATH = os.path.join(os.path.dirname(__file__), "..",
+                       "container-viz", "notebooks",
+                       "mask-rcnn-eksml-tpu-viz.ipynb")
+
+TINY_MODEL = [
+    "DATA.NUM_CLASSES=3",          # BG + person + dog (mini_coco)
+    "BACKBONE.WEIGHTS=",
+    "PREPROC.MAX_SIZE=128",
+    "PREPROC.TRAIN_SHORT_EDGE_SIZE=(128,128)",
+    "PREPROC.TEST_SHORT_EDGE_SIZE=128",
+    "DATA.MAX_GT_BOXES=8",
+    "RPN.TRAIN_PRE_NMS_TOPK=64", "RPN.TRAIN_POST_NMS_TOPK=32",
+    "RPN.TEST_PRE_NMS_TOPK=64", "RPN.TEST_POST_NMS_TOPK=32",
+    "FRCNN.BATCH_PER_IM=16", "FPN.NUM_CHANNEL=32",
+    "FPN.FRCNN_FC_HEAD_DIM=64", "MRCNN.HEAD_DIM=16",
+    "BACKBONE.RESNET_NUM_BLOCKS=(1,1,1,1)",
+    "TEST.RESULTS_PER_IM=8",
+    "TPU.MESH_SHAPE=(1,1)",
+]
+
+
+@pytest.mark.slow
+def test_viz_notebook_executes_end_to_end(mini_coco, tmp_path,
+                                          fresh_config, monkeypatch):
+    import nbformat
+    from nbclient import NotebookClient
+
+    from eksml_tpu import train as train_mod
+
+    # FS_ROOT layout the training JobSet writes: <fs>/<run>/train_log/
+    # maskrcnn + <fs>/data (charts/maskrcnn/templates/maskrcnn.yaml)
+    fs_root = tmp_path / "fs"
+    fs_root.mkdir()
+    logdir = fs_root / "run1" / "train_log" / "maskrcnn"
+    data_dir = fs_root / "data"
+    data_dir.symlink_to(mini_coco)
+
+    train_mod.main([
+        "--logdir", str(logdir),
+        "--total-steps", "1",
+        "--config",
+        f"DATA.BASEDIR={mini_coco}",
+        "TRAIN.STEPS_PER_EPOCH=1", "TRAIN.MAX_EPOCHS=1",
+        "TRAIN.LOG_PERIOD=1", "TRAIN.EVAL_PERIOD=0",
+        "TRAIN.CHECKPOINT_PERIOD=1",
+        *TINY_MODEL,
+    ])
+
+    monkeypatch.setenv("FS_ROOT", str(fs_root))
+    monkeypatch.setenv("EKSML_NB_CONFIG", " ".join(TINY_MODEL))
+    # the notebook kernel is a fresh process: conftest's platform pin
+    # does not reach it, and without this it would compile against the
+    # box's default backend (the axon TPU tunnel)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    nb = nbformat.read(NB_PATH, as_version=4)
+    client = NotebookClient(nb, timeout=600, kernel_name="python3")
+    client.execute()  # raises CellExecutionError on any failing cell
+
+    outs = {i: "".join(
+        o.get("text", "") for o in c.get("outputs", [])
+        if o.get("output_type") == "stream")
+        for i, c in enumerate(nb.cells) if c.cell_type == "code"}
+    all_text = "\n".join(outs.values())
+    # checkpoint discovery found the run and its step
+    assert "using run:" in all_text
+    assert "latest step: 1" in all_text
+    # the predict cell ran and reported a detection count
+    assert "detections" in all_text
+    # the draw cell produced a rendered figure (image/png output)
+    draw_cell = nb.cells[-1]
+    assert any(o.get("output_type") == "display_data"
+               and "image/png" in o.get("data", {})
+               for o in draw_cell.outputs), (
+        "draw_final_outputs figure was not rendered")
+
+
+def test_notebook_sources_stay_runnable():
+    """Cheap structural guard runs on every suite pass (the full
+    execution test is marked slow): every code cell parses, and the
+    env-contract cells reference FS_ROOT / EKSML_NB_CONFIG."""
+    import ast
+
+    nb = json.load(open(NB_PATH))
+    srcs = ["".join(c["source"]) for c in nb["cells"]
+            if c["cell_type"] == "code"]
+    for i, s in enumerate(srcs):
+        ast.parse(s)
+    joined = "\n".join(srcs)
+    assert "FS_ROOT" in joined
+    assert "EKSML_NB_CONFIG" in joined
+    assert "OfflinePredictor" in joined

@@ -298,7 +298,9 @@ def test_vmem_chunk_math_covers_observed_hardware_oom():
         chunk = _roi_chunk(n, out, c, jnp.bfloat16, scratch)
         assert n % chunk == 0
         assert chunk < n  # the failing case MUST be split
-        assert chunk * out * out * c * esize + scratch <= _VMEM_STACK_BUDGET
+        out_pad = out + (-out % 8)
+        assert (chunk * out * out_pad * c * esize + scratch
+                <= _VMEM_STACK_BUDGET)
     # small calls stay single-shot (no perf regression on probes)
     assert _roi_chunk(6, 7, 32, jnp.float32,
                       2 * TILE * TILE * 32 * 4) == 6
@@ -317,7 +319,8 @@ def test_forward_chunked_matches_unchunked(monkeypatch):
     esize = 4
     scratch = 2 * rk.TILE * rk.TILE * 32 * esize
     monkeypatch.setattr(rk, "_VMEM_STACK_BUDGET",
-                        scratch + 4 * 7 * 7 * 32 * esize)
+                        scratch + 4 * 7 * 8 * 32 * esize)
+    # per-ROI size uses the TILED layout (W 7→8)
     assert rk._roi_chunk(12, 7, 32, jnp.float32, scratch) == 4
     chunked = rk._pallas_forward(feats, rois, STRIDES, 7, 2, 2, True)
     np.testing.assert_array_equal(np.asarray(whole), np.asarray(chunked))
@@ -336,7 +339,8 @@ def test_backward_chunked_matches_unchunked(monkeypatch):
     esize = 4
     scratch = rk.TILE * rk.TILE * 32 * esize
     monkeypatch.setattr(rk, "_VMEM_STACK_BUDGET",
-                        scratch + 2 * 7 * 7 * 32 * esize)
+                        scratch + 2 * 7 * 8 * 32 * esize)
+    # per-ROI size uses the TILED layout (W 7→8)
     assert rk._roi_chunk(6, 7, 32, jnp.float32, scratch) == 2
     chunked = rk._pallas_backward(feats, rois, g, STRIDES, 7, 2, 2, True)
     for w, ch in zip(whole, chunked):
