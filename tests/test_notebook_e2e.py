@@ -68,10 +68,11 @@ def test_viz_notebook_executes_end_to_end(mini_coco, tmp_path,
 
     monkeypatch.setenv("FS_ROOT", str(fs_root))
     monkeypatch.setenv("EKSML_NB_CONFIG", " ".join(TINY_MODEL))
-    # the notebook kernel is a fresh process: conftest's platform pin
-    # does not reach it, and without this it would compile against the
-    # box's default backend (the axon TPU tunnel)
-    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # the notebook kernel is a fresh process AND this image's site
+    # hook pre-selects the TPU platform regardless of JAX_PLATFORMS —
+    # the notebook's own EKSML_NB_PLATFORM knob applies the in-Python
+    # config update that actually wins
+    monkeypatch.setenv("EKSML_NB_PLATFORM", "cpu")
 
     nb = nbformat.read(NB_PATH, as_version=4)
     client = NotebookClient(nb, timeout=600, kernel_name="python3")
@@ -109,4 +110,5 @@ def test_notebook_sources_stay_runnable():
     joined = "\n".join(srcs)
     assert "FS_ROOT" in joined
     assert "EKSML_NB_CONFIG" in joined
+    assert "EKSML_NB_PLATFORM" in joined
     assert "OfflinePredictor" in joined
