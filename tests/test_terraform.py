@@ -92,6 +92,30 @@ def test_combined_module_variables_and_outputs():
     assert "ReadWriteMany" in manifests
     assert "google_filestore_instance.shared" in manifests
 
+    # the rendered text must be kubectl-appliable YAML: substitute the
+    # interpolations the way terraform would and parse both documents
+    # (``terraform output -raw shared_fs_manifests | kubectl apply -f -``)
+    import textwrap
+
+    import yaml
+
+    # hcl_lite keeps the whole attr body: extract the heredoc content,
+    # then strip the common leading indent the way terraform's <<- does
+    heredoc = re.search(r"<<-EOT\n(.*?)\n\s*EOT", manifests,
+                        re.DOTALL).group(1)
+    rendered = re.sub(r"\$\{google_filestore_instance[^}]*\}",
+                      "10.0.0.2",
+                       textwrap.dedent(
+                           heredoc.replace(
+                               "${var.filestore_capacity_gb}", "1024")))
+    docs = [d for d in yaml.safe_load_all(rendered) if d]
+    kinds = {d["kind"] for d in docs}
+    assert kinds == {"PersistentVolume", "PersistentVolumeClaim"}
+    pv = next(d for d in docs if d["kind"] == "PersistentVolume")
+    assert pv["spec"]["nfs"]["server"] == "10.0.0.2"
+    pvc = next(d for d in docs if d["kind"] == "PersistentVolumeClaim")
+    assert pvc["spec"]["volumeName"] == pv["metadata"]["name"]
+
 
 # ---- nodepool-only module (≙ aws-eks-nodegroup.tf) ------------------
 
