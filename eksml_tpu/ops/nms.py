@@ -27,7 +27,7 @@ from eksml_tpu.ops.boxes import pairwise_iou
 
 
 def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
-             iou_threshold: float) -> jnp.ndarray:
+             iou_threshold: float, tile: int = 256) -> jnp.ndarray:
     """Greedy NMS keep-mask for boxes ``[K, 4]`` (any order).
 
     Returns a bool ``[K]`` mask in the *input* order.  Padding entries
@@ -35,38 +35,73 @@ def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
     excluded from the keep mask.
 
     TPU formulation: instead of K sequential greedy steps (the CUDA
-    shape of the reference's TF kernel), iterate the fixed point
+    shape of the reference's TF kernel), walk score-sorted *tiles* of
+    ``tile`` boxes.  Tiles are visited in rank order, so by the time a
+    tile is processed every earlier keep decision is final — cross-tile
+    suppression is ONE ``[tile, K]`` masked reduction, no iteration.
+    Within the tile, iterate the synchronous fixed point
 
-        keep_i ← valid_i ∧ ¬∃j:  rank_j < rank_i ∧ IoU(j,i) > t ∧ keep_j
+        keep_i ← alive_i ∧ ¬∃j:  rank_j < rank_i ∧ IoU(j,i) > t ∧ keep_j
 
-    synchronously until unchanged.  Each sweep is one [K,K] masked
-    reduction (VPU-wide); the loop runs for the longest suppression
-    *chain* (typically < 16) rather than K (2000 for RPN proposals),
-    and the fixed point equals exact greedy NMS
+    until unchanged; it runs for the longest suppression *chain inside
+    the tile* (≤ tile, typically ≪).  The global formulation (one
+    fixed point over all K) was profiled at 20.6 ms per FPN level at
+    1344 px — RPN-decoded boxes from dense anchor grids build
+    suppression chains hundreds deep, and each global sweep re-reads a
+    [K,K] matrix from HBM.  Tiling bounds the sequential depth by
+    K/tile outer steps plus per-tile chain depth on a [tile,tile]
+    block that lives in VMEM.  The result is exact greedy NMS
     (tests/test_nms.py cross-checks the sequential recurrence).
     """
     k = boxes.shape[0]
     order = jnp.argsort(-scores)
     sboxes = boxes[order]
-    svalid = jnp.isfinite(scores[order])
-    iou = pairwise_iou(sboxes, sboxes)
-    rank = jnp.arange(k)
-    # sup[j, i]: j would suppress i if j is kept
-    sup = (iou > iou_threshold) & (rank[:, None] < rank[None, :])
+    sscores = scores[order]
+    pad = (-k) % tile
+    if pad:
+        # zero-area padding boxes with -inf scores: IoU 0 against
+        # everything, isfinite=False — they neither keep nor suppress
+        sboxes = jnp.concatenate(
+            [sboxes, jnp.zeros((pad, 4), sboxes.dtype)])
+        sscores = jnp.concatenate(
+            [sscores, jnp.full((pad,), -jnp.inf, sscores.dtype)])
+    kp = k + pad
+    svalid = jnp.isfinite(sscores)
+    rank_t = jnp.arange(tile)
+    rank_all = jnp.arange(kp)
 
-    def cond(state):
-        keep, prev, it = state
-        return (it < k) & jnp.any(keep != prev)
+    def outer(t, keep):
+        t0 = t * tile
+        rows = jax.lax.dynamic_slice(sboxes, (t0, 0), (tile, 4))
+        iou_tk = pairwise_iou(rows, sboxes)            # [tile, kp]
+        alive = jax.lax.dynamic_slice(svalid, (t0,), (tile,))
+        # suppression by FINAL keeps from earlier tiles (rank < t0)
+        prev = keep & (rank_all < t0)
+        alive &= ~jnp.any((iou_tk > iou_threshold) & prev[None, :],
+                          axis=1)
+        # within-tile fixed point on the [tile, tile] diagonal block
+        iou_tt = jax.lax.dynamic_slice(iou_tk, (0, t0), (tile, tile))
+        # sup[j, i]: j would suppress i if j is kept
+        sup = (iou_tt > iou_threshold) & (rank_t[:, None] < rank_t[None, :])
 
-    def body(state):
-        keep, _, it = state
-        new = svalid & ~jnp.any(sup & keep[:, None], axis=0)
-        return new, keep, it + 1
+        def cond(state):
+            cur, prv, it = state
+            return (it < tile) & jnp.any(cur != prv)
 
-    keep_sorted, _, _ = jax.lax.while_loop(
-        cond, body, (svalid, jnp.zeros_like(svalid), jnp.zeros((), jnp.int32)))
+        def body(state):
+            cur, _, it = state
+            new = alive & ~jnp.any(sup & cur[:, None], axis=0)
+            return new, cur, it + 1
+
+        fixed, _, _ = jax.lax.while_loop(
+            cond, body,
+            (alive, jnp.zeros_like(alive), jnp.zeros((), jnp.int32)))
+        return jax.lax.dynamic_update_slice(keep, fixed, (t0,))
+
+    keep_sorted = jax.lax.fori_loop(
+        0, kp // tile, outer, jnp.zeros((kp,), dtype=bool))
     # scatter back to input order
-    return jnp.zeros((k,), dtype=bool).at[order].set(keep_sorted)
+    return jnp.zeros((k,), dtype=bool).at[order].set(keep_sorted[:k])
 
 
 def nms_mask_sequential(boxes: jnp.ndarray, scores: jnp.ndarray,

@@ -330,13 +330,32 @@ def _kernel(out_size: int, sampling: int, num_levels: int, align: int,
 
 
 def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
-                align: int,
+                align: int, overlap: bool,
                 # scalar prefetch (SMEM), one entry per ROI:
                 lvl_ref, b_ref, y0_ref, x0_ref,
                 ys_ref, xs_ref, bh_ref, bw_ref,
                 *refs):
     """Transpose of ``_kernel``: d_tile = RyPᵀ @ g @ CxP, accumulated
-    into the per-level gradient buffer by sequential RMW DMA."""
+    into the per-level gradient buffer by RMW DMA.
+
+    With ``overlap=True`` the write-back is ASYNC: ROI r's out-DMA
+    stays in flight while ROI r+1's tile read and matmuls run (the RMW
+    moves 2×4 MiB per ROI at TILE=64/C=256/f32 — fully serialized
+    read→compute→write was the measured bwd bottleneck at 1344 px).
+    Correctness bookkeeping, all in SMEM scalar flags:
+
+    - two staging slots (``acc_tile[2]``), so the in-flight write's
+      buffer is never the one being refilled;
+    - a RAW-hazard drain: if ROI r's tile REGION (level, batch, y/x
+      origin within TILE) can overlap ROI r-1's, the previous write is
+      waited before r's read — overlapping writes are thereby also
+      ordered (WAW safe);
+    - slot reuse drains the write issued two steps ago, and the final
+      grid step drains everything.
+
+    Every out-DMA moves the same [T,T,C] f32 byte count, so waits are
+    issued against a fixed level-0 region descriptor — a DMA wait is
+    semaphore + byte-count accounting, not an address match."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -344,8 +363,14 @@ def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
     # refs[1 : 1+L] are the zero-initialized ANY inputs aliased to the
     # outputs — unused directly; the RMW goes through the out refs
     acc_refs = refs[1 + num_levels: 1 + 2 * num_levels]  # ANY outputs
-    acc_tile = refs[1 + 2 * num_levels]     # VMEM scratch [T, T, C] f32
-    sem = refs[1 + 2 * num_levels + 1]      # DMA semaphore
+    if overlap:
+        acc_tile = refs[1 + 2 * num_levels]   # VMEM [2, T, T, C] f32
+        in_sem = refs[1 + 2 * num_levels + 1]
+        out_sem = refs[1 + 2 * num_levels + 2]   # DMA sems (2,)
+        pending = refs[1 + 2 * num_levels + 3]   # SMEM (2,) int32
+    else:
+        acc_tile = refs[1 + 2 * num_levels]   # VMEM scratch [T, T, C]
+        sem = refs[1 + 2 * num_levels + 1]    # DMA semaphore
 
     r = pl.program_id(0)
     lvl = lvl_ref[r]
@@ -353,15 +378,63 @@ def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
     y0 = y0_ref[r]
     x0 = x0_ref[r] * align                  # see _kernel: provable align
 
-    # read the current accumulation tile
-    for i in range(num_levels):
-        @pl.when(lvl == i)
-        def _(i=i):
-            dma = pltpu.make_async_copy(
-                acc_refs[i].at[b, pl.ds(y0, TILE), pl.ds(x0, TILE), :],
-                acc_tile, sem)
-            dma.start()
-            dma.wait()
+    if overlap:
+        n = pl.num_programs(0)
+        slot = jax.lax.rem(r, 2)
+
+        @pl.when(r == 0)
+        def _():
+            pending[0] = 0
+            pending[1] = 0
+
+        def wait_out(s):
+            # fixed-region descriptor: same byte count as every
+            # out-DMA (see docstring)
+            pltpu.make_async_copy(
+                acc_tile.at[s],
+                acc_refs[0].at[0, pl.ds(0, TILE), pl.ds(0, TILE), :],
+                out_sem.at[s]).wait()
+
+        # slot reuse: drain the write issued two grid steps ago
+        @pl.when(pending[slot] == 1)
+        def _():
+            wait_out(slot)
+            pending[slot] = 0
+
+        # RAW hazard vs the previous ROI's in-flight write: conservative
+        # region-overlap test on (level, batch, tile origins)
+        rp = jnp.maximum(r - 1, 0)
+        xp = x0_ref[rp] * align
+        same = ((lvl_ref[rp] == lvl) & (b_ref[rp] == b)
+                & (jnp.abs(y0_ref[rp] - y0) < TILE)
+                & (jnp.abs(xp - x0) < TILE))
+
+        @pl.when((r >= 1) & same & (pending[1 - slot] == 1))
+        def _():
+            wait_out(1 - slot)
+            pending[1 - slot] = 0
+
+        # read the current accumulation tile (blocking)
+        for i in range(num_levels):
+            @pl.when(lvl == i)
+            def _(i=i):
+                dma = pltpu.make_async_copy(
+                    acc_refs[i].at[b, pl.ds(y0, TILE),
+                                   pl.ds(x0, TILE), :],
+                    acc_tile.at[slot], in_sem)
+                dma.start()
+                dma.wait()
+    else:
+        # read the current accumulation tile
+        for i in range(num_levels):
+            @pl.when(lvl == i)
+            def _(i=i):
+                dma = pltpu.make_async_copy(
+                    acc_refs[i].at[b, pl.ds(y0, TILE),
+                                   pl.ds(x0, TILE), :],
+                    acc_tile, sem)
+                dma.start()
+                dma.wait()
 
     y_start = ys_ref[r]
     x_start = xs_ref[r]
@@ -394,18 +467,43 @@ def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
         preferred_element_type=f32,
         precision=jax.lax.Precision.HIGHEST).transpose(0, 2, 1)
 
-    acc_tile[:] = acc_tile[:] + d_tile
+    if overlap:
+        acc_tile[slot] = acc_tile[slot] + d_tile
 
-    # write the updated tile back (sequential grid — no races)
-    for i in range(num_levels):
-        @pl.when(lvl == i)
-        def _(i=i):
-            dma = pltpu.make_async_copy(
-                acc_tile,
-                acc_refs[i].at[b, pl.ds(y0, TILE), pl.ds(x0, TILE), :],
-                sem)
-            dma.start()
-            dma.wait()
+        # async write-back: overlaps the next ROI's read + matmuls
+        for i in range(num_levels):
+            @pl.when(lvl == i)
+            def _(i=i):
+                pltpu.make_async_copy(
+                    acc_tile.at[slot],
+                    acc_refs[i].at[b, pl.ds(y0, TILE),
+                                   pl.ds(x0, TILE), :],
+                    out_sem.at[slot]).start()
+        pending[slot] = 1
+
+        # final grid step: nothing after this to drain us — wait both
+        @pl.when(r == n - 1)
+        def _():
+            @pl.when(pending[1 - slot] == 1)
+            def _():
+                wait_out(1 - slot)
+                pending[1 - slot] = 0
+            wait_out(slot)
+            pending[slot] = 0
+    else:
+        acc_tile[:] = acc_tile[:] + d_tile
+
+        # write the updated tile back (sequential grid — no races)
+        for i in range(num_levels):
+            @pl.when(lvl == i)
+            def _(i=i):
+                dma = pltpu.make_async_copy(
+                    acc_tile,
+                    acc_refs[i].at[b, pl.ds(y0, TILE),
+                                   pl.ds(x0, TILE), :],
+                    sem)
+                dma.start()
+                dma.wait()
 
 
 def _prep(feats, rois, strides, out_size, min_level, align):
@@ -477,15 +575,17 @@ _VMEM_STACK_BUDGET = 13 * 2 ** 20   # leave ~3 MiB for spills/semaphores
 
 
 def _roi_chunk(n_total: int, out_size: int, c: int, dtype,
-               scratch_bytes: int) -> int:
+               scratch_bytes: int, extra_budget: int = 0) -> int:
     """Largest divisor of ``n_total`` whose per-call stack estimate
-    (chunk's output + kernel scratch) fits the scoped-vmem budget.
+    (chunk's output + kernel scratch) fits the scoped-vmem budget
+    (module-level ``_VMEM_STACK_BUDGET``, read at call time so tests
+    can monkeypatch it, plus the caller's ``extra_budget``).
     The per-ROI size uses the TILED output layout (W padded to the
     sublane tile, 7→8 / 14→16) — the buffer XLA would actually pack."""
     esize = jnp.dtype(dtype).itemsize
     out_pad = out_size + (-out_size % 8)
     per_roi = out_size * out_pad * c * esize
-    room = max(_VMEM_STACK_BUDGET - scratch_bytes, per_roi)
+    room = max(_VMEM_STACK_BUDGET + extra_budget - scratch_bytes, per_roi)
     bound = max(room // per_roi, 1)
     if n_total <= bound:
         return n_total
@@ -613,8 +713,10 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
     c = padded[0].shape[-1]
     scalars = _prep(padded, rois, strides, out_size, min_level, align)
     num_levels = len(padded)
+    # async write-back pipeline (see _bwd_kernel docstring); A/B knob
+    overlap = os.environ.get("EKSML_BWD_OVERLAP", "1") != "0"
     kern = functools.partial(_bwd_kernel, out_size, sampling,
-                             num_levels, align)
+                             num_levels, align, overlap)
 
     g_flat = g.reshape(b * n, out_size, out_size, c)
 
@@ -626,8 +728,16 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
     # call's partial feature gradients, so memory stays bounded and no
     # extra adds are emitted.
     esize = jnp.dtype(jnp.float32).itemsize
-    scratch_bytes = TILE * TILE * c * esize
-    chunk = _roi_chunk(b * n, out_size, c, g_flat.dtype, scratch_bytes)
+    scratch_bytes = (2 if overlap else 1) * TILE * TILE * c * esize
+    # Overlap doubles the tile scratch (2×4 MiB at TILE=64/C=256).
+    # Keep the chunk count unchanged by granting the bwd call a larger
+    # stack budget, and pay for it by shaving the same 4 MiB off the
+    # accumulator PIN budget below — worst case stays
+    # g-chunk (≤ budget−scratch) + scratch + unpinned accs ≤ 31 MiB
+    # under the 32 MiB scoped limit.
+    extra = TILE * TILE * c * esize if overlap else 0
+    chunk = _roi_chunk(b * n, out_size, c, g_flat.dtype, scratch_bytes,
+                       extra_budget=extra)
 
     def call(chunk_scalars, g_chunk, accs, n_rois):
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -645,10 +755,15 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             # XLA's buffer placement — the with_memory_space_constraint
             # on the aliased inputs below is what pins them to HBM.
             out_specs=[pl.BlockSpec(memory_space=pltpu.HBM)] * num_levels,
-            scratch_shapes=[
-                pltpu.VMEM((TILE, TILE, c), jnp.float32),
-                pltpu.SemaphoreType.DMA(()),
-            ],
+            scratch_shapes=(
+                [pltpu.VMEM((2, TILE, TILE, c), jnp.float32),
+                 pltpu.SemaphoreType.DMA(()),
+                 pltpu.SemaphoreType.DMA((2,)),
+                 pltpu.SMEM((2,), jnp.int32)]
+                if overlap else
+                [pltpu.VMEM((TILE, TILE, c), jnp.float32),
+                 pltpu.SemaphoreType.DMA(()),
+                 ]),
         )
         out_shape = tuple(
             _hbm_out(f.shape, jnp.float32) if pinned[i]
@@ -688,7 +803,7 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             order = sorted(range(num_levels), key=lambda i: -sizes[i])
             remaining = sum(sizes)
             for i in order:
-                if remaining <= 12 * 2 ** 20:
+                if remaining <= 12 * 2 ** 20 - extra:
                     break
                 pinned[i] = True
                 remaining -= sizes[i]
@@ -700,7 +815,7 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             # 512px/b4 on v5e); a level that cannot fit the scoped
             # limit at all is left unpinned for free
             kept = 0
-            budget = min(18 * 2 ** 20, limit - 14 * 2 ** 20)
+            budget = min(18 * 2 ** 20, limit - 14 * 2 ** 20) - extra
             for i in range(num_levels):
                 if sizes[i] >= limit:
                     continue
@@ -728,13 +843,44 @@ def _probe_bwd_compile(dtype) -> bool:
     and fixture as ``_probe_compile``: Mosaic can reject what
     interpret accepts)."""
     try:
+        from eksml_tpu.ops.roi_align import (assign_fpn_levels_tile_fit,
+                                             batched_multilevel_roi_align)
+
         feats, rois = _probe_fixture(dtype)
+        strides = (4, 8, 16, 32)
         g = jnp.ones((1, 128, 14, 14, 256), dtype)
-        out = _pallas_backward(feats, rois, g, (4, 8, 16, 32), 14, 2, 2,
+        out = _pallas_backward(feats, rois, g, strides, 14, 2, 2,
                                False)
         jax.block_until_ready(out)
-        return all(bool(np.isfinite(np.asarray(o, np.float32)).all())
-                   for o in out)
+        if not all(bool(np.isfinite(np.asarray(o, np.float32)).all())
+                   for o in out):
+            return False
+        # numeric cross-check against the XLA formulation's VJP on the
+        # same tile-fit levels: the fixture's 64×-duplicated ROIs make
+        # consecutive grid steps hit the SAME accumulator tiles, so a
+        # write-pipeline hazard bug (async write-back, _bwd_kernel)
+        # would drop tile updates here — finite but wrong.  Loose
+        # tolerance: both sides accumulate in different orders.
+        b, n = rois.shape[0], rois.shape[1]
+        levels = assign_fpn_levels_tile_fit(
+            rois.reshape(b * n, 4), strides, len(feats), TILE,
+            min_level=2, align=sublane_align(dtype)).reshape(b, n)
+        _, vjp = jax.vjp(
+            lambda fs: batched_multilevel_roi_align(
+                fs, rois, strides, 14, 2, 2, levels=levels), feats)
+        ref = vjp(g)[0]
+        for o, rf in zip(out, ref):
+            o32 = np.asarray(o, np.float32)
+            r32 = np.asarray(rf, np.float32)
+            scale = max(float(np.abs(r32).max()), 1e-6)
+            if float(np.abs(o32 - r32).max()) > 0.05 * scale:
+                log.warning(
+                    "Pallas ROIAlign backward FAILED the numeric "
+                    "cross-check for %s (max |Δ| %.4g vs scale %.4g) "
+                    "— falling back to XLA", np.dtype(dtype),
+                    float(np.abs(o32 - r32).max()), scale)
+                return False
+        return True
     except Exception as e:  # noqa: BLE001
         log.warning("Pallas ROIAlign backward unavailable for %s "
                     "(falling back to XLA): %s", np.dtype(dtype), e)

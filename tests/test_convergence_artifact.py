@@ -22,12 +22,17 @@ _ART_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def _artifacts():
+    """Banked artifacts keyed by name tag: plain rounds key as int N
+    (``convergence_r3.json`` → 3), suffixed variants keep the string
+    tag (``convergence_r5_tpu.json`` → "5_tpu") — hardware runs bank
+    alongside the round's CPU run without colliding."""
     out = {}
     for path in sorted(glob.glob(os.path.join(_ART_DIR,
                                               "convergence_r*.json"))):
-        n = int(os.path.basename(path)[len("convergence_r"):-len(".json")])
+        tag = os.path.basename(path)[len("convergence_r"):-len(".json")]
+        key = int(tag) if tag.isdigit() else tag
         with open(path) as f:
-            out[n] = json.load(f)
+            out[key] = json.load(f)
     return out
 
 

@@ -121,3 +121,58 @@ def test_fixed_point_chain():
 
     keep = np.asarray(nms_mask(boxes, scores, 0.5))
     assert keep.tolist() == [True, False, True]
+
+
+def test_tiled_multi_tile_equals_sequential():
+    """Exactness across tile boundaries: with a small tile size, random
+    clustered boxes spanning many tiles must still match the O(K)-step
+    greedy recurrence (cross-tile suppression + per-tile fixed point)."""
+    from eksml_tpu.ops.nms import nms_mask, nms_mask_sequential
+
+    rng = np.random.RandomState(7)
+    for trial, (n, tile) in enumerate([(100, 16), (97, 32), (256, 64),
+                                       (130, 128), (33, 8)]):
+        ctr = rng.rand(n, 2) * 50
+        wh = rng.rand(n, 2) * 30 + 5
+        boxes = jnp.asarray(np.concatenate([ctr, ctr + wh], 1)
+                            .astype(np.float32))
+        scores = jnp.asarray(rng.rand(n).astype(np.float32))
+        a = np.asarray(nms_mask(boxes, scores, 0.5, tile=tile))
+        b = np.asarray(nms_mask_sequential(boxes, scores, 0.5))
+        np.testing.assert_array_equal(a, b, err_msg=f"trial {trial}")
+
+
+def test_tiled_chain_spans_tiles():
+    """A suppression chain laid across tile boundaries: box i overlaps
+    only box i+1 (IoU≈0.54) with descending scores, so greedy keeps
+    every EVEN-ranked box.  With tile=4 the chain's keep/kill
+    alternation must propagate through cross-tile suppression."""
+    from eksml_tpu.ops.nms import nms_mask
+
+    n = 16
+    boxes = np.zeros((n, 4), np.float32)
+    for i in range(n):
+        # unit-height boxes slid by 0.3: IoU(i, i+1) = 0.7/1.3 ≈ 0.54,
+        # IoU(i, i+2) = 0.4/1.6 = 0.25 < 0.5
+        boxes[i] = [i * 0.3, 0, i * 0.3 + 1.0, 1.0]
+    scores = np.linspace(0.9, 0.1, n).astype(np.float32)
+    keep = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores),
+                               0.5, tile=4))
+    assert keep.tolist() == [i % 2 == 0 for i in range(n)]
+
+
+def test_tiled_padding_not_multiple_of_tile():
+    """K deliberately not a multiple of tile: internal -inf padding
+    rows must neither keep nor suppress."""
+    from eksml_tpu.ops.nms import nms_mask, nms_mask_sequential
+
+    rng = np.random.RandomState(3)
+    n = 45
+    ctr = rng.rand(n, 2) * 30
+    wh = rng.rand(n, 2) * 20 + 4
+    boxes = jnp.asarray(np.concatenate([ctr, ctr + wh], 1)
+                        .astype(np.float32))
+    scores = jnp.asarray(rng.rand(n).astype(np.float32))
+    a = np.asarray(nms_mask(boxes, scores, 0.5, tile=32))
+    b = np.asarray(nms_mask_sequential(boxes, scores, 0.5))
+    np.testing.assert_array_equal(a, b)

@@ -1,0 +1,134 @@
+#!/bin/bash
+# Round-5b harvest: bank the evidence for THIS session's perf work
+# (tiled NMS, stacked per-level NMS, Pallas-bwd async write-back) the
+# moment a healthy tunnel window lands the fresh headline ladder.
+#
+# Order (cheap/decisive first, same tunnel discipline as tpu_harvest.sh
+# — never kill a client mid-compile, one TPU client at a time):
+#   1. bwd-overlap A/B at the 1344/b4 headline (EKSML_BWD_OVERLAP=0/1;
+#      the tiled-NMS delta is read against the git-banked r5 rungs,
+#      which ran the same flags on the same chip)
+#   2. long hardware convergence (2500 steps) — promoted to
+#      convergence_r5.json only if bbox AP50 beats the r3 CPU bar
+#   3. fresh profiled run + trace summary (was the NMS phase actually
+#      cut?)
+#
+# The ONE deviation from "never kill": a convergence client that has
+# written ZERO training steps for 35 minutes is dead (today's observed
+# failure: backend init hung while the tunnel port stayed open; the
+# process held the slot for 50 min with zero IO).  Zero-step kills
+# cannot be mid-compile-cache-write: the persistent cache commits per
+# XLA module, and a client that never stepped never held a partially
+# compiled train step worth preserving.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_harvest_r5b.log
+
+say() { echo "[r5b] $(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+wait_slot() {
+    while pgrep -af "python bench.py|tools/convergence_run.py" \
+        2>/dev/null | grep -v "platform cpu" | grep -q .; do
+        sleep 60
+    done
+}
+
+run_single() {  # run_single <tag> <extra env...> -- <bench args...>
+    local tag=$1; shift
+    local envs=()
+    while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+    shift
+    wait_slot
+    say "run $tag: ${envs[*]:-} bench.py --single $*"
+    env "${envs[@]}" python bench.py --single "$@" \
+        --init-retries 3 --init-timeout 300 \
+        2>>"$LOG" | tail -1 > "artifacts/$tag.json.tmp" \
+        && mv "artifacts/$tag.json.tmp" "artifacts/$tag.json"
+    say "done $tag: $(head -c 200 "artifacts/$tag.json" 2>/dev/null)"
+}
+
+say "waiting for fresh headline (BENCH_LOCAL.json)"
+while [ ! -s BENCH_LOCAL.json ]; do sleep 120; done
+say "headline landed: $(head -c 200 BENCH_LOCAL.json)"
+
+# ---- 1. bwd async-write-back attribution at the headline point -----
+run_single roi_ab_overlap_off_1344 EKSML_BWD_OVERLAP=0 -- \
+    --steps 10 --image-size 1344 --batch-size 4 \
+    --roi-backend pallas --roi-bwd pallas
+run_single roi_ab_overlap_on_1344 EKSML_BWD_OVERLAP=1 -- \
+    --steps 10 --image-size 1344 --batch-size 4 \
+    --roi-backend pallas --roi-bwd pallas
+python - >> "$LOG" 2>&1 <<'EOF'
+import json
+rows = []
+for tag in ("roi_ab_overlap_off_1344", "roi_ab_overlap_on_1344"):
+    try:
+        d = json.load(open(f"artifacts/{tag}.json"))
+    except Exception:
+        continue
+    rows.append({"run": tag, **{k: d.get(k) for k in (
+        "value", "step_time_ms", "mfu", "device_kind", "error")}})
+json.dump({"runs": rows},
+          open("artifacts/roi_ab_overlap_r5b.json", "w"), indent=1)
+print("merged overlap A/B:", rows)
+EOF
+say "overlap A/B merged"
+
+# ---- 2. long hardware convergence with a zero-progress watchdog ----
+wait_slot
+say "long TPU convergence: 2500 steps @512/b4"
+python tools/convergence_run.py --steps 2500 --size 512 --batch-size 4 \
+    --num-train 200 --num-val 30 \
+    --out artifacts/convergence_r5_tpu_long.json \
+    --config RPN.TRAIN_PRE_NMS_TOPK=512 RPN.TRAIN_POST_NMS_TOPK=128 \
+    RPN.TEST_PRE_NMS_TOPK=512 RPN.TEST_POST_NMS_TOPK=128 \
+    FRCNN.BATCH_PER_IM=128 TRAIN.GRADIENT_CLIP=0.36 BACKBONE.NORM=GN \
+    >> "$LOG" 2>&1 &
+conv_pid=$!
+# watchdog: kill ONLY a zero-step client (see header); a stepping run
+# is left alone no matter how slow
+for _ in $(seq 35); do
+    sleep 60
+    kill -0 "$conv_pid" 2>/dev/null || break
+    if ls /tmp/shapes_coco_*/run/metrics.jsonl >/dev/null 2>&1 \
+        && [ -n "$(find /tmp/shapes_coco_*/run/metrics.jsonl -size +0c \
+                   -newermt '-40 minutes' 2>/dev/null)" ]; then
+        say "convergence stepping; watchdog standing down"
+        break
+    fi
+done
+if kill -0 "$conv_pid" 2>/dev/null \
+    && ! find /tmp/shapes_coco_*/run/metrics.jsonl -size +0c \
+         >/dev/null 2>&1; then
+    say "convergence wrote ZERO steps in 35 min — killing hung client"
+    kill "$conv_pid" 2>/dev/null
+fi
+wait "$conv_pid" 2>/dev/null
+if reason=$(python -c '
+import json, sys
+try:
+    d = json.load(open("artifacts/convergence_r5_tpu_long.json"))
+except Exception:
+    print("no artifact"); sys.exit(1)
+if d.get("device", "").lower() in ("", "cpu", "host"):
+    print("ran on CPU fallback"); sys.exit(1)
+old = json.load(open("artifacts/convergence_r3.json"))
+if d.get("bbox_AP50", 0) < old.get("bbox_AP50", 0):
+    print("AP50 %.3f below r3 bar %.3f" % (
+        d.get("bbox_AP50", 0), old.get("bbox_AP50", 0)))
+    sys.exit(1)
+'); then
+    cp artifacts/convergence_r5_tpu_long.json artifacts/convergence_r5.json
+    say "long convergence PROMOTED to convergence_r5.json"
+else
+    say "long convergence not promoted: $reason"
+fi
+
+# ---- 3. fresh profile: did the NMS/bwd phases actually shrink? -----
+run_single bench_profiled_r5b -- --steps 10 --image-size 1344 \
+    --batch-size 4 --profile 8
+if python tools/trace_summary.py profile \
+    --out artifacts/profile_summary_r5b.json >> "$LOG" 2>&1; then
+    say "fresh profile summary banked"
+fi
+say "r5b harvest complete"
