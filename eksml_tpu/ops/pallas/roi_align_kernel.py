@@ -110,7 +110,14 @@ def _probe_fixture(dtype):
                              256), dtype) for s in (4, 8, 16, 32))
     base = np.asarray([[4.0, 4.0, 36.0, 36.0],
                        [8.0, 8.0, 200.0, 120.0]], np.float32)
-    rois = jnp.asarray(np.tile(base, (64, 1))[None], jnp.float32)
+    # np.repeat, NOT np.tile: consecutive grid steps must hit the SAME
+    # level/batch/tile region so the backward's async-write-back RAW
+    # hazard drain is actually exercised by the hardware probe (an
+    # interleaved A,B,A,B order puts the two boxes on different FPN
+    # levels and the drain path would never fire — code review r5);
+    # the single A-block→B-block boundary still covers the cross-level
+    # adjacent case.
+    rois = jnp.asarray(np.repeat(base, 64, axis=0)[None], jnp.float32)
     return feats, rois
 
 

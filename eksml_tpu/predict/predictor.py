@@ -96,13 +96,15 @@ class OfflinePredictor:
             return quantize_uint8(im), scale, (nh, nw)
         return (im - self.mean) / self.std, scale, (nh, nw)
 
-    def __call__(self, image: np.ndarray,
-                 score_thresh: Optional[float] = None
-                 ) -> List[DetectionResult]:
-        """Single-image inference in original coordinates."""
-        from eksml_tpu.data.masks import paste_mask
-
-        h, w = image.shape[:2]
+    def raw(self, image: np.ndarray):
+        """Raw output tensors in RESIZED-image coordinates, plus the
+        resize scale: ``({boxes, scores, classes, valid[, masks]},
+        scale)``, each ``[1, RESULTS_PER_IM, ...]`` numpy.  This is the
+        explicit-output flow of the reference's OPTIMIZED viz notebook
+        (container-optimized-viz/notebooks/mask-rcnn-tensorflow-viz
+        .ipynb cells 11, 16 fetch named output tensors and post-process
+        by hand); ``__call__`` is the high-level path the tensorpack
+        notebook uses."""
         im, scale, (nh, nw) = self._preprocess(image)
         # Clip to the resized content extent, not the padded canvas —
         # matches the eval path (evalcoco/runner.py) so both produce
@@ -110,7 +112,16 @@ class OfflinePredictor:
         hw = np.asarray([[nh, nw]], np.float32)
         out = self._predict(self.params, jnp.asarray(im[None]),
                             jnp.asarray(hw))
-        out = jax.tree.map(np.asarray, out)
+        return jax.tree.map(np.asarray, out), scale
+
+    def __call__(self, image: np.ndarray,
+                 score_thresh: Optional[float] = None
+                 ) -> List[DetectionResult]:
+        """Single-image inference in original coordinates."""
+        from eksml_tpu.data.masks import paste_mask
+
+        h, w = image.shape[:2]
+        out, scale = self.raw(image)
         thresh = (self.cfg.TEST.RESULT_SCORE_THRESH
                   if score_thresh is None else score_thresh)
         results = []

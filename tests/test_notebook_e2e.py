@@ -21,6 +21,9 @@ import pytest
 NB_PATH = os.path.join(os.path.dirname(__file__), "..",
                        "container-viz", "notebooks",
                        "mask-rcnn-eksml-tpu-viz.ipynb")
+NB_OPT_PATH = os.path.join(os.path.dirname(__file__), "..",
+                           "container-optimized-viz", "notebooks",
+                           "mask-rcnn-eksml-tpu-optimized-viz.ipynb")
 
 TINY_MODEL = [
     "DATA.NUM_CLASSES=3",          # BG + person + dog (mini_coco)
@@ -40,8 +43,16 @@ TINY_MODEL = [
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("nb_path,precision", [
+    (NB_PATH, None),
+    # the optimized notebook pins TRAIN.PRECISION=bfloat16 (the
+    # optimized chart's training precision) — train its fixture
+    # checkpoint in bf16 so restore dtypes match
+    (NB_OPT_PATH, "bfloat16"),
+], ids=["tensorpack-flow", "optimized-flow"])
 def test_viz_notebook_executes_end_to_end(mini_coco, tmp_path,
-                                          fresh_config, monkeypatch):
+                                          fresh_config, monkeypatch,
+                                          nb_path, precision):
     import nbformat
     from nbclient import NotebookClient
 
@@ -63,6 +74,7 @@ def test_viz_notebook_executes_end_to_end(mini_coco, tmp_path,
         "TRAIN.STEPS_PER_EPOCH=1", "TRAIN.MAX_EPOCHS=1",
         "TRAIN.LOG_PERIOD=1", "TRAIN.EVAL_PERIOD=0",
         "TRAIN.CHECKPOINT_PERIOD=1",
+        *([f"TRAIN.PRECISION={precision}"] if precision else []),
         *TINY_MODEL,
     ])
 
@@ -74,7 +86,7 @@ def test_viz_notebook_executes_end_to_end(mini_coco, tmp_path,
     # config update that actually wins
     monkeypatch.setenv("EKSML_NB_PLATFORM", "cpu")
 
-    nb = nbformat.read(NB_PATH, as_version=4)
+    nb = nbformat.read(nb_path, as_version=4)
     client = NotebookClient(nb, timeout=600, kernel_name="python3")
     client.execute()  # raises CellExecutionError on any failing cell
 
@@ -88,21 +100,29 @@ def test_viz_notebook_executes_end_to_end(mini_coco, tmp_path,
     assert "latest step: 1" in all_text
     # the predict cell ran and reported a detection count
     assert "detections" in all_text
+    if nb_path is NB_OPT_PATH:
+        # explicit-output flow: the raw-tensor cell printed the named
+        # output tensors (the reference optimized notebook's cell 11)
+        assert "output/boxes" in all_text
+        assert "output/masks" in all_text
+        assert "resize scale:" in all_text
     # the draw cell produced a rendered figure (image/png output)
     draw_cell = nb.cells[-1]
     assert any(o.get("output_type") == "display_data"
                and "image/png" in o.get("data", {})
                for o in draw_cell.outputs), (
-        "draw_final_outputs figure was not rendered")
+        "overlay figure was not rendered")
 
 
-def test_notebook_sources_stay_runnable():
+@pytest.mark.parametrize("nb_path", [NB_PATH, NB_OPT_PATH],
+                         ids=["tensorpack-flow", "optimized-flow"])
+def test_notebook_sources_stay_runnable(nb_path):
     """Cheap structural guard runs on every suite pass (the full
     execution test is marked slow): every code cell parses, and the
     env-contract cells reference FS_ROOT / EKSML_NB_CONFIG."""
     import ast
 
-    nb = json.load(open(NB_PATH))
+    nb = json.load(open(nb_path))
     srcs = ["".join(c["source"]) for c in nb["cells"]
             if c["cell_type"] == "code"]
     for i, s in enumerate(srcs):

@@ -346,3 +346,26 @@ def test_backward_chunked_matches_unchunked(monkeypatch):
     for w, ch in zip(whole, chunked):
         np.testing.assert_allclose(np.asarray(w), np.asarray(ch),
                                    atol=1e-5)
+
+
+def test_backward_overlap_matches_serial(monkeypatch):
+    """The async write-back pipeline (EKSML_BWD_OVERLAP=1, default)
+    must reproduce the serial RMW path bit-for-bit in interpret mode —
+    including on DUPLICATED ROIs, where consecutive grid steps RMW the
+    same accumulator tiles (the hazard the pipeline's drain logic
+    exists for)."""
+    from eksml_tpu.ops.pallas import roi_align_kernel as rk
+
+    rng = np.random.RandomState(11)
+    feats = _feats(rng, b=1)
+    base = _rois(rng, 1, 4)
+    # interleave duplicates: r and r+1 always hit the same tile region
+    rois = jnp.asarray(np.repeat(np.asarray(base), 2, axis=1))
+    g = jnp.asarray(rng.randn(1, 8, 7, 7, 32).astype(np.float32))
+
+    monkeypatch.setenv("EKSML_BWD_OVERLAP", "0")
+    serial = rk._pallas_backward(feats, rois, g, STRIDES, 7, 2, 2, True)
+    monkeypatch.setenv("EKSML_BWD_OVERLAP", "1")
+    overlap = rk._pallas_backward(feats, rois, g, STRIDES, 7, 2, 2, True)
+    for s, o in zip(serial, overlap):
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(o))

@@ -42,9 +42,18 @@ run_single() {  # run_single <tag> <extra env...> -- <bench args...>
     say "run $tag: ${envs[*]:-} bench.py --single $*"
     env "${envs[@]}" python bench.py --single "$@" \
         --init-retries 3 --init-timeout 300 \
-        2>>"$LOG" | tail -1 > "artifacts/$tag.json.tmp" \
-        && mv "artifacts/$tag.json.tmp" "artifacts/$tag.json"
-    say "done $tag: $(head -c 200 "artifacts/$tag.json" 2>/dev/null)"
+        2>>"$LOG" | tail -1 > "artifacts/$tag.json.tmp"
+    # promote only non-empty parseable JSON: the pipeline exits with
+    # tail's status, so a crashed bench would otherwise bank an empty
+    # artifact and log "done" (code review r5)
+    if python -c "import json,sys; json.load(open(sys.argv[1]))" \
+        "artifacts/$tag.json.tmp" 2>/dev/null; then
+        mv "artifacts/$tag.json.tmp" "artifacts/$tag.json"
+        say "done $tag: $(head -c 200 "artifacts/$tag.json")"
+    else
+        rm -f "artifacts/$tag.json.tmp"
+        say "FAILED $tag: bench produced no JSON (see $LOG)"
+    fi
 }
 
 say "waiting for fresh headline (BENCH_LOCAL.json)"
@@ -77,8 +86,21 @@ say "overlap A/B merged"
 # ---- 2. long hardware convergence with a zero-progress watchdog ----
 wait_slot
 say "long TPU convergence: 2500 steps @512/b4"
+# pre-create the dataset dir so the watchdog tracks THIS run's
+# metrics file, not a stale /tmp/shapes_coco_* glob from an earlier
+# (possibly hung) attempt (code review r5)
+conv_dir=$(mktemp -d /tmp/shapes_coco_r5b.XXXXXX)
+python - "$conv_dir" >> "$LOG" 2>&1 <<'EOF'
+import sys
+from tools.make_shapes_coco import make_split
+base = sys.argv[1]
+make_split(base, "train2017", 200, 512, 0, 1000)
+make_split(base, "val2017", 30, 512, 1, 100000)
+print("r5b dataset at", base)
+EOF
+conv_metrics="$conv_dir/run/metrics.jsonl"
 python tools/convergence_run.py --steps 2500 --size 512 --batch-size 4 \
-    --num-train 200 --num-val 30 \
+    --data "$conv_dir" \
     --out artifacts/convergence_r5_tpu_long.json \
     --config RPN.TRAIN_PRE_NMS_TOPK=512 RPN.TRAIN_POST_NMS_TOPK=128 \
     RPN.TEST_PRE_NMS_TOPK=512 RPN.TEST_POST_NMS_TOPK=128 \
@@ -90,16 +112,12 @@ conv_pid=$!
 for _ in $(seq 35); do
     sleep 60
     kill -0 "$conv_pid" 2>/dev/null || break
-    if ls /tmp/shapes_coco_*/run/metrics.jsonl >/dev/null 2>&1 \
-        && [ -n "$(find /tmp/shapes_coco_*/run/metrics.jsonl -size +0c \
-                   -newermt '-40 minutes' 2>/dev/null)" ]; then
+    if [ -s "$conv_metrics" ]; then
         say "convergence stepping; watchdog standing down"
         break
     fi
 done
-if kill -0 "$conv_pid" 2>/dev/null \
-    && ! find /tmp/shapes_coco_*/run/metrics.jsonl -size +0c \
-         >/dev/null 2>&1; then
+if kill -0 "$conv_pid" 2>/dev/null && [ ! -s "$conv_metrics" ]; then
     say "convergence wrote ZERO steps in 35 min — killing hung client"
     kill "$conv_pid" 2>/dev/null
 fi
