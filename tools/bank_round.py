@@ -109,6 +109,18 @@ def collect(round_num: int, since: str | None = None) -> dict:
                     round((by[pallas].get("value") or 0)
                           / by[xla]["value"], 3)
 
+    # r5b: bwd async-write-back attribution pair (EKSML_BWD_OVERLAP
+    # off/on at the 1344/b4 headline) — merged by tpu_harvest_r5b.sh
+    oab = _load(os.path.join(art, "roi_ab_overlap_r5b.json"))
+    if oab and oab.get("runs"):
+        by = {r["run"]: r for r in oab["runs"]
+              if not r.get("error") and is_hardware(r)}
+        on = by.get("roi_ab_overlap_on_1344")
+        off = by.get("roi_ab_overlap_off_1344")
+        if on and off and on.get("value") and off.get("value"):
+            out["bwd_overlap_speedup_1344"] = round(
+                on["value"] / off["value"], 3)
+
     for r in (round_num, round_num - 1):
         d = _load(os.path.join(art, f"convergence_r{r}.json"))
         if d:
