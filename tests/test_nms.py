@@ -176,3 +176,34 @@ def test_tiled_padding_not_multiple_of_tile():
     a = np.asarray(nms_mask(boxes, scores, 0.5, tile=32))
     b = np.asarray(nms_mask_sequential(boxes, scores, 0.5))
     np.testing.assert_array_equal(a, b)
+
+
+def test_stacked_level_nms_equals_per_level_loop():
+    """models/rpn.py stacks unequal-k levels into one [L, kmax] vmapped
+    nms_mask call (padding with zero-area/-inf rows).  The stack must
+    reproduce a plain per-level loop exactly, including on levels
+    shorter than kmax."""
+    import jax
+
+    rng = np.random.RandomState(5)
+    level_ks = [96, 96, 96, 40, 13]   # mimics P2-P5 at pre_nms_topk + short P6
+    kmax = max(level_ks)
+    per_level, stack_b, stack_s = [], [], []
+    for k in level_ks:
+        ctr = rng.rand(k, 2) * 60
+        wh = rng.rand(k, 2) * 30 + 5
+        b = np.concatenate([ctr, ctr + wh], 1).astype(np.float32)
+        s = rng.rand(k).astype(np.float32)
+        per_level.append(np.asarray(
+            nms_mask(jnp.asarray(b), jnp.asarray(s), 0.5, tile=32)))
+        stack_b.append(np.pad(b, ((0, kmax - k), (0, 0))))
+        stack_s.append(np.pad(s, (0, kmax - k),
+                              constant_values=-np.inf))
+    keep = jax.vmap(
+        lambda bb, ss: nms_mask(bb, ss, 0.5, tile=32))(
+        jnp.asarray(np.stack(stack_b)), jnp.asarray(np.stack(stack_s)))
+    keep = np.asarray(keep)
+    for lvl, k in enumerate(level_ks):
+        np.testing.assert_array_equal(
+            keep[lvl, :k], per_level[lvl], err_msg=f"level {lvl}")
+        assert not keep[lvl, k:].any()   # padding never kept
