@@ -110,13 +110,9 @@ def _probe_fixture(dtype):
                              256), dtype) for s in (4, 8, 16, 32))
     base = np.asarray([[4.0, 4.0, 36.0, 36.0],
                        [8.0, 8.0, 200.0, 120.0]], np.float32)
-    # np.repeat, NOT np.tile: consecutive grid steps must hit the SAME
-    # level/batch/tile region so the backward's async-write-back RAW
-    # hazard drain is actually exercised by the hardware probe (an
-    # interleaved A,B,A,B order puts the two boxes on different FPN
-    # levels and the drain path would never fire — code review r5);
-    # the single A-block→B-block boundary still covers the cross-level
-    # adjacent case.
+    # (the BWD probe builds its own hazard-dense ROI set — see
+    # _probe_bwd_compile; this fixture only needs the production
+    # count/shape class)
     rois = jnp.asarray(np.repeat(base, 64, axis=0)[None], jnp.float32)
     return feats, rois
 
@@ -727,6 +723,26 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
 
     g_flat = g.reshape(b * n, out_size, out_size, c)
 
+    # De-cluster the grid order: accumulation is order-independent, so
+    # walk ROIs by a fixed coprime stride (golden-ratio spacing).
+    # Consecutive proposals/fg-ROIs are spatially CLUSTERED (score
+    # order; objects), which is exactly when the async write-back's
+    # RAW-hazard drain must serialize — a stride walk makes adjacent
+    # grid steps land on unrelated tiles so the overlap pipeline
+    # actually overlaps.  Applied regardless of the overlap flag so
+    # serial/overlap A/B (and the bitwise equality test) see the same
+    # accumulation order.
+    bn = b * n
+    if bn > 2:
+        from math import gcd
+
+        stride = max(2, round(bn * 0.618))
+        while gcd(stride, bn) != 1:
+            stride += 1
+        perm = (jnp.arange(bn) * stride) % bn   # bijection: coprime
+        scalars = tuple(x[perm] for x in scalars)
+        g_flat = g_flat[perm]
+
     # Same scoped-vmem stack bound as the forward, from the other side:
     # the incoming gradient is this kernel's big windowed buffer, and
     # XLA electing to keep it vmem-resident would put all b·n ROIs of
@@ -853,7 +869,16 @@ def _probe_bwd_compile(dtype) -> bool:
         from eksml_tpu.ops.roi_align import (assign_fpn_levels_tile_fit,
                                              batched_multilevel_roi_align)
 
-        feats, rois = _probe_fixture(dtype)
+        feats, _ = _probe_fixture(dtype)
+        # 120 copies of one box + 8 of a second-level box: under ANY
+        # grid order (including the de-clustering stride permutation in
+        # _pallas_backward) most consecutive steps still RMW the SAME
+        # accumulator tile, so the async-write-back hazard drain is
+        # genuinely exercised — and the second box keeps a cross-level
+        # adjacency in the mix.
+        base = np.asarray([[4.0, 4.0, 36.0, 36.0]] * 120
+                          + [[8.0, 8.0, 200.0, 120.0]] * 8, np.float32)
+        rois = jnp.asarray(base[None], jnp.float32)
         strides = (4, 8, 16, 32)
         g = jnp.ones((1, 128, 14, 14, 256), dtype)
         out = _pallas_backward(feats, rois, g, strides, 14, 2, 2,
