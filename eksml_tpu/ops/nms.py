@@ -28,7 +28,7 @@ from eksml_tpu.ops.boxes import pairwise_iou
 
 
 def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
-             iou_threshold: float, tile: int = None) -> jnp.ndarray:
+             iou_threshold: float, tile: int | None = None) -> jnp.ndarray:
     """Greedy NMS keep-mask for boxes ``[K, 4]`` (any order).
 
     Returns a bool ``[K]`` mask in the *input* order.  Padding entries
@@ -61,6 +61,10 @@ def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
     """
     if tile is None:
         tile = int(os.environ.get("EKSML_NMS_TILE", "256"))
+    if tile <= 0:
+        raise ValueError(
+            f"NMS tile size must be positive, got {tile} "
+            "(check EKSML_NMS_TILE)")
     k = boxes.shape[0]
     order = jnp.argsort(-scores)
     sboxes = boxes[order]

@@ -739,7 +739,11 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
         stride = max(2, round(bn * 0.618))
         while gcd(stride, bn) != 1:
             stride += 1
-        perm = (jnp.arange(bn) * stride) % bn   # bijection: coprime
+        # host-side int64: i*stride overflows int32 past bn ≈ 58k ROIs
+        # and the "bijection" would silently drop/double-count
+        # gradients; numpy folds this to a constant
+        perm = jnp.asarray(
+            (np.arange(bn, dtype=np.int64) * stride) % bn, jnp.int32)
         scalars = tuple(x[perm] for x in scalars)
         g_flat = g_flat[perm]
 
@@ -888,11 +892,12 @@ def _probe_bwd_compile(dtype) -> bool:
                    for o in out):
             return False
         # numeric cross-check against the XLA formulation's VJP on the
-        # same tile-fit levels: the fixture's 64×-duplicated ROIs make
-        # consecutive grid steps hit the SAME accumulator tiles, so a
-        # write-pipeline hazard bug (async write-back, _bwd_kernel)
-        # would drop tile updates here — finite but wrong.  Loose
-        # tolerance: both sides accumulate in different orders.
+        # same tile-fit levels: with the hazard-dense 120-same-box ROI
+        # set above, most consecutive grid steps RMW the SAME
+        # accumulator tile under any order, so a write-pipeline hazard
+        # bug (async write-back, _bwd_kernel) would drop tile updates
+        # here — finite but wrong.  Loose tolerance: both sides
+        # accumulate in different orders.
         b, n = rois.shape[0], rois.shape[1]
         levels = assign_fpn_levels_tile_fit(
             rois.reshape(b * n, 4), strides, len(feats), TILE,
