@@ -207,3 +207,18 @@ def test_stacked_level_nms_equals_per_level_loop():
         np.testing.assert_array_equal(
             keep[lvl, :k], per_level[lvl], err_msg=f"level {lvl}")
         assert not keep[lvl, k:].any()   # padding never kept
+
+
+def test_nms_tile_env_knob(monkeypatch):
+    """EKSML_NMS_TILE is read at trace time and validated."""
+    import pytest
+
+    boxes = jnp.asarray([[0, 0, 10, 10], [100, 100, 110, 110]],
+                        jnp.float32)
+    scores = jnp.asarray([0.9, 0.8])
+    monkeypatch.setenv("EKSML_NMS_TILE", "8")
+    keep = np.asarray(nms_mask(boxes, scores, 0.5))
+    assert keep.all()
+    monkeypatch.setenv("EKSML_NMS_TILE", "0")
+    with pytest.raises(ValueError, match="EKSML_NMS_TILE"):
+        nms_mask(boxes, scores, 0.5)
