@@ -150,3 +150,10 @@ if python tools/trace_summary.py profile \
     say "fresh profile summary banked"
 fi
 say "r5b harvest complete"
+
+# ---- 4. batch-8 headline probe: does a bigger batch lift MFU? ------
+# HBM-OOM auto-retries once with remat inside bench.py (--single path
+# included, bench.py:_run_with_remat); artifact is labeled by its own
+# batch_size/remat fields either way.
+run_single bench_1344_b8 -- --steps 10 --image-size 1344 --batch-size 8
+say "r5b extended harvest complete"
