@@ -383,7 +383,7 @@ def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
 
     if overlap:
         n = pl.num_programs(0)
-        slot = jax.lax.rem(r, 2)
+        slot = r % 2
 
         @pl.when(r == 0)
         def _():
@@ -398,24 +398,30 @@ def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
                 acc_refs[0].at[0, pl.ds(0, TILE), pl.ds(0, TILE), :],
                 out_sem.at[s]).wait()
 
-        # slot reuse: drain the write issued two grid steps ago
-        @pl.when(pending[slot] == 1)
-        def _():
-            wait_out(slot)
-            pending[slot] = 0
+        # All SMEM flag accesses use STATIC indices (slot-parity
+        # branches): the forward kernel proves dynamic VMEM slot
+        # indexing on hardware, but a dynamically-indexed SMEM STORE
+        # is an unproven Mosaic construct — don't bet the probe on it.
+        def drain(s, extra_cond):
+            @pl.when(extra_cond & (pending[s] == 1))
+            def _():
+                wait_out(s)
+                pending[s] = 0
 
-        # RAW hazard vs the previous ROI's in-flight write: conservative
-        # region-overlap test on (level, batch, tile origins)
+        # slot reuse: drain the write issued two grid steps ago
+        drain(0, slot == 0)
+        drain(1, slot == 1)
+
+        # RAW hazard vs the previous ROI's in-flight write (lives on
+        # the OTHER slot): conservative region-overlap test on
+        # (level, batch, tile origins)
         rp = jnp.maximum(r - 1, 0)
         xp = x0_ref[rp] * align
-        same = ((lvl_ref[rp] == lvl) & (b_ref[rp] == b)
+        same = ((r >= 1) & (lvl_ref[rp] == lvl) & (b_ref[rp] == b)
                 & (jnp.abs(y0_ref[rp] - y0) < TILE)
                 & (jnp.abs(xp - x0) < TILE))
-
-        @pl.when((r >= 1) & same & (pending[1 - slot] == 1))
-        def _():
-            wait_out(1 - slot)
-            pending[1 - slot] = 0
+        drain(0, same & (slot == 1))
+        drain(1, same & (slot == 0))
 
         # read the current accumulation tile (blocking)
         for i in range(num_levels):
@@ -482,17 +488,30 @@ def _bwd_kernel(out_size: int, sampling: int, num_levels: int,
                     acc_refs[i].at[b, pl.ds(y0, TILE),
                                    pl.ds(x0, TILE), :],
                     out_sem.at[slot]).start()
-        pending[slot] = 1
+
+        @pl.when(slot == 0)
+        def _():
+            pending[0] = 1
+
+        @pl.when(slot == 1)
+        def _():
+            pending[1] = 1
 
         # final grid step: nothing after this to drain us — wait both
-        @pl.when(r == n - 1)
-        def _():
-            @pl.when(pending[1 - slot] == 1)
+        # (static slot-parity branches; own slot's pending was just
+        # set, the other's may have been hazard-drained already)
+        last = r == n - 1
+
+        def final_drain(s, my_slot):
+            @pl.when(last & (slot == my_slot) & (pending[s] == 1))
             def _():
-                wait_out(1 - slot)
-                pending[1 - slot] = 0
-            wait_out(slot)
-            pending[slot] = 0
+                wait_out(s)
+                pending[s] = 0
+
+        final_drain(1, 0)   # other slot first (the older write)
+        final_drain(0, 1)
+        final_drain(0, 0)   # then the write this very step issued
+        final_drain(1, 1)
     else:
         acc_tile[:] = acc_tile[:] + d_tile
 
