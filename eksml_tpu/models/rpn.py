@@ -69,20 +69,27 @@ def match_anchors(anchors: jnp.ndarray, gt_boxes: jnp.ndarray,
     """
     crowd = jnp.zeros_like(gt_valid) if gt_crowd is None else gt_crowd
     target_ok = (gt_valid > 0) & (crowd == 0)
-    iou_all = pairwise_iou(anchors, gt_boxes)  # [A, G]
-    iou = iou_all * target_ok[None, :].astype(iou_all.dtype)
-    best_iou = iou.max(axis=1)
-    matched_gt = iou.argmax(axis=1)
+    # [G, A], NOT [A, G]: A is ~450k at 1344 px while G ≤ MAX_GT_BOXES
+    # (8) — the anchor axis must own the 128-wide lane dim.  The [A, G]
+    # orientation ran at ~6% lane utilization and 6.7 GB/s (profiled
+    # fusion.35, 10.8 ms/step at 1344/b4).  argmax tie-breaking (first
+    # max wins) is orientation-independent here: per-anchor reductions
+    # run over axis 0 and per-GT reductions over axis 1, both
+    # returning the lowest tied index exactly as before.
+    iou_all = pairwise_iou(gt_boxes, anchors)  # [G, A]
+    iou = iou_all * target_ok[:, None].astype(iou_all.dtype)
+    best_iou = iou.max(axis=0)
+    matched_gt = iou.argmax(axis=0)
     labels = jnp.full(anchors.shape[0], -1, jnp.int32)
     labels = jnp.where(best_iou < neg_thresh, 0, labels)
     labels = jnp.where(best_iou >= pos_thresh, 1, labels)
     # crowd overlap → ignore (only demotes background, never positives)
-    crowd_iou = (iou_all * ((gt_valid > 0) & (crowd > 0))[None, :]
-                 ).max(axis=1)
+    crowd_iou = (iou_all * ((gt_valid > 0) & (crowd > 0))[:, None]
+                 ).max(axis=0)
     labels = jnp.where((labels == 0) & (crowd_iou >= neg_thresh), -1, labels)
     # force-match: every valid non-crowd GT gets its best anchor positive
-    best_anchor_per_gt = iou.argmax(axis=0)  # [G]
-    gt_best_iou = iou.max(axis=0)
+    best_anchor_per_gt = iou.argmax(axis=1)  # [G]
+    gt_best_iou = iou.max(axis=1)
     force = target_ok & (gt_best_iou > 1e-3)
     labels = labels.at[best_anchor_per_gt].set(
         jnp.where(force, 1, labels[best_anchor_per_gt]))
