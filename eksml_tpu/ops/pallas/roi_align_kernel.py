@@ -70,20 +70,47 @@ _PROBE_RESULTS: dict = {}  # dtype → cached hardware compile-probe
 # oom").  Applied lazily from _gate() so every pallas-enabled entry
 # point (bench, trainer, predictor) gets it before the first compile,
 # and never when the XLA backend is forced.
-_SCOPED_VMEM_KIB = int(os.environ.get("EKSML_SCOPED_VMEM_KIB", "32768"))
+_SCOPED_VMEM_KIB = 32768
+
+
+def _scoped_vmem_kib() -> int:
+    """The ONE read point for the EKSML_SCOPED_VMEM_KIB override —
+    read at call time so both carriers of the limit (the env flag and
+    the per-kernel compiler params) always agree, whenever the
+    operator sets it (code review r5b)."""
+    return int(os.environ.get("EKSML_SCOPED_VMEM_KIB",
+                              str(_SCOPED_VMEM_KIB)))
 
 
 def ensure_scoped_vmem_limit(kib: int | None = None) -> None:
     """Append ``--xla_tpu_scoped_vmem_limit_kib`` to LIBTPU_INIT_ARGS
-    (idempotent; an operator-provided value wins).  libtpu forwards
-    these as per-compile options, so setting it before the first pallas
-    compile suffices — same mechanism set_xla_collective_flags uses."""
+    (idempotent; an operator-provided value wins).  NOT sufficient on
+    its own: under remote compilation (axon) the compile server
+    snapshots ITS OWN env at PJRT-plugin init, so a flag appended in
+    the client process after backend init never reaches the compiler
+    (observed round 5: the probe compile was rejected at the 16 MiB
+    default while the client env carried the 32 MiB flag).  The limit
+    that actually governs every kernel is therefore also passed
+    per-call via ``_compiler_params()`` — it rides inside the Mosaic
+    custom call and survives any compile topology.  This env flag is
+    kept as belt-and-braces for in-process backends."""
     flags = os.environ.get("LIBTPU_INIT_ARGS", "")
     if "scoped_vmem_limit" in flags:
         return
-    kib = kib or _SCOPED_VMEM_KIB
+    kib = kib or _scoped_vmem_kib()
     os.environ["LIBTPU_INIT_ARGS"] = (
         f"{flags} --xla_tpu_scoped_vmem_limit_kib={kib}").strip()
+
+
+def _compiler_params():
+    """Per-kernel Mosaic params carrying the scoped-vmem stack limit
+    IN the compiled module (see ensure_scoped_vmem_limit: the env flag
+    dies at the remote-compile boundary).  Read at call time so the
+    EKSML_SCOPED_VMEM_KIB override works per-process."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        vmem_limit_bytes=_scoped_vmem_kib() * 1024)
 
 
 def sublane_align(dtype) -> int:
@@ -662,6 +689,7 @@ def _pallas_forward(feats, rois, strides, out_size, sampling, min_level,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct(
                 (n_rois, out_size, out_pad, c), feats[0].dtype),
+            compiler_params=_compiler_params(),
             interpret=interpret,
         )(*chunk_scalars, *feats)
 
@@ -719,6 +747,9 @@ def _to_hbm(x):
         out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
         scratch_shapes=[pltpu.SemaphoreType.DMA(())],
         out_shape=_hbm_out(x.shape, x.dtype),
+        # a >16 MiB input XLA elects to keep vmem-resident must not
+        # bust THIS kernel's stack check either
+        compiler_params=_compiler_params(),
     )(x)
 
 
@@ -822,6 +853,7 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             # accumulator i (flat arg index 8 scalars + 1 g + i) owns
             # output buffer i: the kernel RMWs it through the out refs
             input_output_aliases={9 + i: i for i in range(num_levels)},
+            compiler_params=_compiler_params(),
             interpret=interpret,
         )(*chunk_scalars, g_chunk, *accs)
 
@@ -839,7 +871,7 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
     sizes = [int(np.prod(f.shape)) * 4 for f in padded]
     pinned = [False] * num_levels
     if not interpret and os.environ.get("EKSML_BWD_PIN", "1") != "0":
-        limit = _SCOPED_VMEM_KIB * 1024
+        limit = _scoped_vmem_kib() * 1024
         if jnp.dtype(feats[0].dtype) == jnp.float32:
             # f32 graphs carry double-size temps everywhere and the
             # packer runs much hotter (the round-5 f32 convergence

@@ -373,3 +373,72 @@ def test_backward_overlap_matches_serial(monkeypatch):
     overlap = rk._pallas_backward(feats, rois, g, STRIDES, 7, 2, 2, True)
     for s, o in zip(serial, overlap):
         np.testing.assert_array_equal(np.asarray(s), np.asarray(o))
+
+
+def _pallas_eqn_compiler_params(fn, *args):
+    """Collect the compiler_params of every pallas_call equation in
+    fn's jaxpr (recursing through closed subjaxprs)."""
+    from jax._src import core as jc
+
+    found = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                found.append(eqn.params.get("compiler_params"))
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (tuple, list)) else (v,)
+                for w in vs:
+                    if isinstance(w, jc.ClosedJaxpr):
+                        walk(w.jaxpr)
+                    elif isinstance(w, jc.Jaxpr):
+                        walk(w)
+
+    walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    return found
+
+
+def _assert_vmem_limit(params_list, kib):
+    assert params_list, "no pallas_call equation found"
+    for cp in params_list:
+        mosaic = cp["mosaic_tpu"] if "mosaic_tpu" in cp else cp
+        assert mosaic.vmem_limit_bytes == kib * 1024, mosaic
+
+
+def test_vmem_limit_rides_in_the_kernel(monkeypatch):
+    """Round-5 hardware regression: under remote compilation (axon)
+    the compile server snapshots its own env at plugin init, so the
+    LIBTPU_INIT_ARGS scoped-vmem flag appended client-side after
+    backend init never reached the compiler — the probe compile was
+    rejected at the 16 MiB default (272 KiB over) and the whole
+    training path silently fell back to XLA ROIAlign.  The limit must
+    therefore travel IN the compiled module: assert every pallas_call
+    the fwd, bwd, and HBM-laundering paths emit carries
+    compiler_params.vmem_limit_bytes — the per-kernel knob that
+    survives any compile topology."""
+    from eksml_tpu.ops.pallas import roi_align_kernel as rk
+
+    rng = np.random.RandomState(3)
+    feats = _feats(rng, b=1)
+    rois = _rois(rng, 1, 4)
+    g = jnp.asarray(rng.randn(1, 4, 7, 7, 32).astype(np.float32))
+
+    fwd = _pallas_eqn_compiler_params(
+        lambda f, r: rk._pallas_forward(f, r, STRIDES, 7, 2, 2, True),
+        feats, rois)
+    _assert_vmem_limit(fwd, rk._SCOPED_VMEM_KIB)
+
+    # bwd path includes the _to_hbm laundering kernels for the pinned
+    # accumulators plus the chained RMW kernel itself
+    bwd = _pallas_eqn_compiler_params(
+        lambda f, r, gg: rk._pallas_backward(
+            f, r, gg, STRIDES, 7, 2, 2, True),
+        feats, rois, g)
+    _assert_vmem_limit(bwd, rk._SCOPED_VMEM_KIB)
+
+    # the env override must flow through to the emitted kernels
+    monkeypatch.setenv("EKSML_SCOPED_VMEM_KIB", "65536")
+    fwd = _pallas_eqn_compiler_params(
+        lambda f, r: rk._pallas_forward(f, r, STRIDES, 7, 2, 2, True),
+        feats, rois)
+    _assert_vmem_limit(fwd, 65536)
