@@ -102,15 +102,17 @@ def ensure_scoped_vmem_limit(kib: int | None = None) -> None:
         f"{flags} --xla_tpu_scoped_vmem_limit_kib={kib}").strip()
 
 
-def _compiler_params():
+def _compiler_params(extra_bytes: int = 0):
     """Per-kernel Mosaic params carrying the scoped-vmem stack limit
     IN the compiled module (see ensure_scoped_vmem_limit: the env flag
     dies at the remote-compile boundary).  Read at call time so the
-    EKSML_SCOPED_VMEM_KIB override works per-process."""
+    EKSML_SCOPED_VMEM_KIB override works per-process.  The ONE
+    construction site for the limit: callers whose kernel carries
+    extra scratch (the bwd overlap pipeline) declare it here."""
     from jax.experimental.pallas import tpu as pltpu
 
     return pltpu.CompilerParams(
-        vmem_limit_bytes=_scoped_vmem_kib() * 1024)
+        vmem_limit_bytes=_scoped_vmem_kib() * 1024 + extra_bytes)
 
 
 def sublane_align(dtype) -> int:
@@ -808,10 +810,15 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
     scratch_bytes = (2 if overlap else 1) * TILE * TILE * c * esize
     # Overlap doubles the tile scratch (2×4 MiB at TILE=64/C=256).
     # Keep the chunk count unchanged by granting the bwd call a larger
-    # stack budget, and pay for it by shaving the same 4 MiB off the
-    # accumulator PIN budget below — worst case stays
-    # g-chunk (≤ budget−scratch) + scratch + unpinned accs ≤ 31 MiB
-    # under the 32 MiB scoped limit.
+    # stack budget — and, now that the per-kernel compiler params
+    # demonstrably reach the compiler (see _compiler_params), declare
+    # the extra scratch in THIS call's vmem limit instead of trying to
+    # squeeze the accumulator pin budget: on r5b hardware the 1344/b4
+    # bf16 overlap compile needed 35.94 MiB (= the measured serial-path
+    # stack + one extra staging slot) against the base 32 MiB, and
+    # shrinking the pin budget did NOT keep the pinned accumulator off
+    # the stack.  base + 2×extra gives the observed need ~4 MiB of
+    # headroom while staying far under v5e's 128 MiB of vmem.
     extra = TILE * TILE * c * esize if overlap else 0
     chunk = _roi_chunk(b * n, out_size, c, g_flat.dtype, scratch_bytes,
                        extra_budget=extra)
@@ -853,7 +860,7 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             # accumulator i (flat arg index 8 scalars + 1 g + i) owns
             # output buffer i: the kernel RMWs it through the out refs
             input_output_aliases={9 + i: i for i in range(num_levels)},
-            compiler_params=_compiler_params(),
+            compiler_params=_compiler_params(extra_bytes=2 * extra),
             interpret=interpret,
         )(*chunk_scalars, g_chunk, *accs)
 
@@ -864,9 +871,12 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
     # vmem-local (pinning everything costs ~12% step time at
     # 512px/b4), while unpinned-large is the round-5 compile failure
     # (XLA vmem-placed the zeros broadcasts and the aliased chain
-    # dragged 29 MiB onto the Mosaic stack).  Pin until the unpinned
-    # sum ≤ 24 MiB: unpinned + tile scratch + blocks then stays ≥3 MiB
-    # clear of the 32 MiB scoped limit even if XLA packs every
+    # dragged 29 MiB onto the Mosaic stack).  The budgets below keep
+    # the unpinned sum small enough that unpinned + g-chunk + tile
+    # scratch fits the limit the RMW kernel itself declares — base
+    # 32 MiB plus, on the overlap path, 2x the extra staging slot
+    # (r5b hardware: 35.94 MiB observed need at 1344/b4 bf16, ~4 MiB
+    # headroom under the 40 MiB grant) — even if XLA packs every
     # unpinned buffer.
     sizes = [int(np.prod(f.shape)) * 4 for f in padded]
     pinned = [False] * num_levels
@@ -881,7 +891,7 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             order = sorted(range(num_levels), key=lambda i: -sizes[i])
             remaining = sum(sizes)
             for i in order:
-                if remaining <= 12 * 2 ** 20 - extra:
+                if remaining <= 12 * 2 ** 20:
                     break
                 pinned[i] = True
                 remaining -= sizes[i]
@@ -893,7 +903,13 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             # 512px/b4 on v5e); a level that cannot fit the scoped
             # limit at all is left unpinned for free
             kept = 0
-            budget = min(18 * 2 ** 20, limit - 14 * 2 ** 20) - extra
+            # part-1-measured residency policy (17.9 vs 16.3 img/s at
+            # 512/b4 with the finest level vmem-eligible); the overlap
+            # path's extra scratch is paid for by bwd_limit_bytes, NOT
+            # by evicting accumulators — r5b hardware showed the pin
+            # escape hatch doesn't reliably keep an aliased
+            # accumulator off the stack anyway
+            budget = min(18 * 2 ** 20, limit - 14 * 2 ** 20)
             for i in range(num_levels):
                 if sizes[i] >= limit:
                     continue
