@@ -903,12 +903,11 @@ def _pallas_backward(feats, rois, g, strides, out_size, sampling,
             # 512px/b4 on v5e); a level that cannot fit the scoped
             # limit at all is left unpinned for free
             kept = 0
-            # part-1-measured residency policy (17.9 vs 16.3 img/s at
-            # 512/b4 with the finest level vmem-eligible); the overlap
-            # path's extra scratch is paid for by bwd_limit_bytes, NOT
-            # by evicting accumulators — r5b hardware showed the pin
-            # escape hatch doesn't reliably keep an aliased
-            # accumulator off the stack anyway
+            # the overlap path's extra scratch is paid for by the
+            # per-call extra_bytes grant in _compiler_params, NOT by
+            # shrinking this budget — r5b hardware showed evicting a
+            # pinned aliased accumulator doesn't reliably keep it off
+            # the stack anyway
             budget = min(18 * 2 ** 20, limit - 14 * 2 ** 20)
             for i in range(num_levels):
                 if sizes[i] >= limit:

@@ -143,11 +143,30 @@ else
 fi
 
 # ---- 3. fresh profile: did the NMS/bwd phases actually shrink? -----
+# drop any prior run's promoted artifact first: the freshness guard
+# below reads it, and run_single only cleans up .tmp files on failure
+rm -f artifacts/bench_profiled_r5b.json
 run_single bench_profiled_r5b -- --steps 10 --image-size 1344 \
     --batch-size 4 --profile 8
-if python tools/trace_summary.py profile \
-    --out artifacts/profile_summary_r5b.json >> "$LOG" 2>&1; then
-    say "fresh profile summary banked"
+# Summarize ONLY a trace this run produced: a failed profiled bench
+# leaves the previous session's trace as the newest dir, and
+# trace_summary would bank the OLD step under the fresh r5b label
+# (observed 20:42 UTC — a stale-evidence hazard, deleted by hand).
+if python - <<'EOF'
+import json, sys
+try:
+    d = json.load(open("artifacts/bench_profiled_r5b.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if (d.get("value") or 0) > 0 else 1)
+EOF
+then
+    if python tools/trace_summary.py profile \
+        --out artifacts/profile_summary_r5b.json >> "$LOG" 2>&1; then
+        say "fresh profile summary banked"
+    fi
+else
+    say "profiled bench failed; NOT summarizing the stale trace"
 fi
 say "r5b harvest complete"
 
