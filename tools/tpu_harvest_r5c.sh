@@ -35,6 +35,7 @@ run_single() {  # run_single <tag> <extra env...> -- <bench args...>
     while [ "$1" != "--" ]; do envs+=("$1"); shift; done
     shift
     wait_slot
+    wait_port
     say "run $tag: ${envs[*]:-} bench.py --single $*"
     env "${envs[@]}" python bench.py --single "$@" \
         --init-retries 3 --init-timeout 300 \
@@ -54,9 +55,31 @@ run_single() {  # run_single <tag> <extra env...> -- <bench args...>
 # the probe STILL failing would bank roi=auto with the same 5.62-class
 # value — detect via the banked rung's value and retry a bounded
 # number of times)
+wait_port() {
+    # During a CLOSED-port window bench.py's pre-flight rejects in
+    # milliseconds; without this wait each rejection would consume a
+    # ladder attempt and the whole budget would burn in minutes.
+    # Attempts are for REAL failures (init hang on an open port, bad
+    # numbers) — port-closed time is free.  Logs once per ~10 min.
+    local n=0
+    while ! python - <<'EOF'
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", 8103), timeout=0.75).close()
+except OSError:
+    sys.exit(1)
+EOF
+    do
+        n=$((n + 1))
+        [ $((n % 20)) -eq 1 ] && say "tunnel port closed (x$n); waiting"
+        sleep 30
+    done
+}
+
 ladder_ok=""
 for i in 1 2 3 4 5 6; do
     wait_slot
+    wait_port
     say "ladder attempt $i"
     python bench.py --steps 20 --init-retries 3 --init-timeout 300 \
         > .bench_r5c.tmp 2>>"$LOG"
@@ -111,6 +134,7 @@ say "overlap A/B merged"
 
 # ---- 3. long hardware convergence, cache warm + patient probe ------
 wait_slot
+wait_port
 say "long TPU convergence: 2500 steps @512/b4 (probe timeout 600)"
 conv_dir=$(mktemp -d /tmp/shapes_coco_r5c.XXXXXX)
 python - "$conv_dir" >> "$LOG" 2>&1 <<'EOF'
