@@ -222,3 +222,22 @@ def test_nms_tile_env_knob(monkeypatch):
     monkeypatch.setenv("EKSML_NMS_TILE", "0")
     with pytest.raises(ValueError, match="EKSML_NMS_TILE"):
         nms_mask(boxes, scores, 0.5)
+
+
+def test_microbench_vendored_old_nms_agrees():
+    """tools/op_microbench.py vendors the pre-tiling global fixed
+    point for on-device old-vs-new attribution; the comparison is only
+    meaningful if the vendored copy still computes exact greedy NMS —
+    pin it to the production mask on clustered inputs."""
+    from tools.op_microbench import nms_mask_global_fixedpoint
+
+    np.random.seed(5)
+    for _ in range(3):
+        boxes = _rand_cluster_boxes(96)
+        scores = np.random.rand(96).astype(np.float32)
+        scores[::7] = -np.inf  # padding lanes stay inert in both
+        new = np.asarray(nms_mask(jnp.asarray(boxes),
+                                  jnp.asarray(scores), 0.5))
+        old = np.asarray(nms_mask_global_fixedpoint(
+            jnp.asarray(boxes), jnp.asarray(scores), 0.5))
+        np.testing.assert_array_equal(new, old)
