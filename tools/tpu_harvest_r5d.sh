@@ -1,0 +1,100 @@
+#!/bin/bash
+# Round-5d harvest: after r5c banks the headline evidence, spend the
+# remaining window on ATTRIBUTION — which ops actually moved.
+#   1. op_microbench on the TPU (old-vs-new NMS + matching at the
+#      production 1344/b4 shapes) -> artifacts/op_microbench_tpu.json
+#   2. fresh profiled headline bench + trace summary (freshness-guarded
+#      like the patched r5b: only summarize a trace THIS run produced)
+#   3. batch-8 headline probe (MFU headroom)
+# Same tunnel discipline: one client at a time, port-wait, never kill.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_harvest_r5d.log
+
+say() { echo "[r5d] $(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+wait_slot() {
+    while pgrep -af \
+        "python bench.py|tools/convergence_run.py|tools/op_microbench.py" \
+        2>/dev/null | grep -v "platform cpu" | grep -q .; do
+        sleep 60
+    done
+}
+
+wait_port() {
+    local n=0
+    while ! python - <<'EOF'
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", 8103), timeout=0.75).close()
+except OSError:
+    sys.exit(1)
+EOF
+    do
+        n=$((n + 1))
+        [ $((n % 20)) -eq 1 ] && say "tunnel port closed (x$n); waiting"
+        sleep 30
+    done
+}
+
+run_single() {  # run_single <tag> <extra env...> -- <bench args...>
+    local tag=$1; shift
+    local envs=()
+    while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+    shift
+    wait_slot
+    wait_port
+    say "run $tag: ${envs[*]:-} bench.py --single $*"
+    env "${envs[@]}" python bench.py --single "$@" \
+        --init-retries 3 --init-timeout 300 \
+        2>>"$LOG" | tail -1 > "artifacts/$tag.json.tmp"
+    if python -c "import json,sys; json.load(open(sys.argv[1]))" \
+        "artifacts/$tag.json.tmp" 2>/dev/null; then
+        mv "artifacts/$tag.json.tmp" "artifacts/$tag.json"
+        say "done $tag: $(head -c 200 "artifacts/$tag.json")"
+    else
+        rm -f "artifacts/$tag.json.tmp"
+        say "FAILED $tag: bench produced no JSON (see $LOG)"
+    fi
+}
+
+say "waiting for r5c to finish"
+while ! grep -q "r5c harvest complete" tpu_harvest_r5c.log 2>/dev/null; do
+    sleep 120
+done
+say "r5c done; starting attribution runs"
+
+# ---- 1. op microbench at production shapes -------------------------
+wait_slot
+wait_port
+say "op_microbench (TPU, 1344 shapes)"
+python tools/op_microbench.py --iters 20 --image-size 1344 \
+    --batch 4 --pre-nms 2000 \
+    --out artifacts/op_microbench_tpu.json >> "$LOG" 2>&1 \
+    && say "op_microbench banked: $(head -c 300 artifacts/op_microbench_tpu.json)" \
+    || say "op_microbench FAILED (see $LOG)"
+
+# ---- 2. fresh profile, freshness-guarded ---------------------------
+rm -f artifacts/bench_profiled_r5b.json
+run_single bench_profiled_r5b -- --steps 10 --image-size 1344 \
+    --batch-size 4 --profile 8
+if python - <<'EOF'
+import json, sys
+try:
+    d = json.load(open("artifacts/bench_profiled_r5b.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if (d.get("value") or 0) > 0 else 1)
+EOF
+then
+    if python tools/trace_summary.py profile \
+        --out artifacts/profile_summary_r5b.json >> "$LOG" 2>&1; then
+        say "fresh profile summary banked"
+    fi
+else
+    say "profiled bench failed; NOT summarizing the stale trace"
+fi
+
+# ---- 3. batch-8 headline probe -------------------------------------
+run_single bench_1344_b8 -- --steps 10 --image-size 1344 --batch-size 8
+say "r5d harvest complete"
