@@ -81,6 +81,12 @@ for i in 1 2 3 4 5 6; do
     wait_slot
     wait_port
     say "ladder attempt $i"
+    # EKSML_PROBE_TIMEOUT=600: the first post-wake probe compile is
+    # COLD (the persistent cache only helps once a probe compile has
+    # completed in some client) and routinely exceeds the 120s
+    # default over the tunnel — which silently measures the XLA
+    # fallback and burns the attempt on a 5.6-class number
+    EKSML_PROBE_TIMEOUT=600 \
     python bench.py --steps 20 --init-retries 3 --init-timeout 300 \
         > .bench_r5c.tmp 2>>"$LOG"
     line=$(tail -1 .bench_r5c.tmp)
