@@ -303,6 +303,15 @@ def main(argv=None):
         diag["trace_tail"] = "".join(
             traceback.format_exception(type(e), e, e.__traceback__)
         ).splitlines()[-3:]
+        # probe evidence matters MOST on failed runs (a probe reject
+        # followed by a crash is the hardest case to reconstruct);
+        # cheap, side-effect-free, never raises
+        try:
+            from eksml_tpu.ops.pallas.roi_align_kernel import \
+                probe_outcomes
+            diag.setdefault("roi_probe_outcomes", probe_outcomes())
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
         _attach_last_good(diag)
         _emit(diag)
     # a timed-out init attempt leaves a non-daemon worker thread stuck
@@ -634,6 +643,12 @@ def run(args, diag: dict) -> None:
     diag["vs_baseline"] = (0.0 if fwd_only else
                            round(per_chip / V100_IMAGES_PER_SEC, 3))
     diag["step_time_ms"] = round(step_ms, 1)
+    # make roi=auto self-describing: which backend did the per-dtype
+    # probes actually choose?  (round 5: a compile-environment reject
+    # silently measured the XLA fallback across a whole ladder, and
+    # only the 2x throughput gap gave it away)
+    from eksml_tpu.ops.pallas.roi_align_kernel import probe_outcomes
+    diag["roi_probe_outcomes"] = probe_outcomes()
     if flops_per_step:
         peak = PEAK_FLOPS.get(dev_kind, DEFAULT_PEAK)
         mfu = flops_per_step / (dt / args.steps) / (peak * n_dev)

@@ -990,6 +990,16 @@ def _probe_bwd_compile(dtype) -> bool:
         return False
 
 
+def probe_outcomes() -> dict:
+    """Per-dtype hardware probe outcomes recorded in THIS process
+    (empty when the mode was forced via env or no gate ran).  Bench
+    artifacts embed this so a ``roi=auto`` number is self-describing:
+    the round-5 16-MiB-default reject silently measured the XLA
+    fallback for a whole ladder, and nothing in the artifact said so."""
+    return {"fwd": {k: bool(v) for k, v in _PROBE_RESULTS.items()},
+            "bwd": {k: bool(v) for k, v in _BWD_PROBE.items()}}
+
+
 def pallas_roi_bwd_supported(dtype=jnp.float32) -> bool:
     """Backward-kernel gate: ``EKSML_ROI_BWD={auto,pallas,xla}`` —
     auto probes on real TPU (once per dtype), xla forces the gather

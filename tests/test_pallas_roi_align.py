@@ -465,3 +465,20 @@ def test_vmem_limit_rides_in_the_kernel(monkeypatch):
         lambda f, r: rk._pallas_forward(f, r, STRIDES, 7, 2, 2, True),
         feats, rois)
     _assert_vmem_limit(fwd, 65536)
+
+
+def test_probe_outcomes_reflects_gate_cache(monkeypatch):
+    """bench artifacts embed probe_outcomes() so a roi=auto number is
+    self-describing (round 5: a compile reject silently measured the
+    XLA fallback for a whole ladder).  The report must mirror the
+    per-dtype gate caches and nothing else."""
+    from eksml_tpu.ops.pallas import roi_align_kernel as rk
+
+    monkeypatch.setattr(rk, "_PROBE_RESULTS", {})
+    monkeypatch.setattr(rk, "_BWD_PROBE", {})
+    assert rk.probe_outcomes() == {"fwd": {}, "bwd": {}}
+
+    monkeypatch.setattr(rk, "_PROBE_RESULTS", {"bfloat16": True})
+    monkeypatch.setattr(rk, "_BWD_PROBE", {"bfloat16": False})
+    assert rk.probe_outcomes() == {"fwd": {"bfloat16": True},
+                                   "bwd": {"bfloat16": False}}
