@@ -285,6 +285,53 @@ def _define_defaults() -> None:
     # device.slice_index on real multi-slice deployments.
     _C.TPU.NUM_SLICES = 1
 
+    # ---- resilience (eksml_tpu/resilience/) -------------------------
+    # The in-process half of the fault story; the orchestration half is
+    # the chart's failurePolicy/podFailurePolicy (SURVEY.md §5.3: the
+    # reference has restartPolicy Never and rerun-by-hand, nothing else).
+    # SIGTERM grace window → forced checkpoint at the next step boundary,
+    # then exit PREEMPT_EXIT_CODE ("preempted, resumable") — the charts'
+    # podFailurePolicy maps exactly this code to restart-not-fail, so
+    # the two MUST stay in sync (tests/test_orchestration.py pins
+    # values.yaml preempt_exit_code to this default).
+    _C.RESILIENCE.GRACEFUL_SHUTDOWN = True
+    _C.RESILIENCE.PREEMPT_EXIT_CODE = 77
+    # steps between the cross-host "anyone preempted?" agreement
+    # collective.  Multi-host only (single-process checks its local
+    # flag every step for free); the poll is a host-blocking allgather,
+    # so per-step polling would break the async-dispatch pipelining.
+    # 0 = piggyback on LOG_PERIOD; an explicit N bounds the
+    # SIGTERM→forced-checkpoint latency to N steps (keep
+    # N·step_time well inside terminationGracePeriodSeconds)
+    _C.RESILIENCE.PREEMPT_SYNC_PERIOD = 0
+    # per-file sha256 in the post-commit integrity manifest (sizes are
+    # always recorded; digests re-read every checkpoint byte at save)
+    _C.RESILIENCE.CHECKPOINT_DIGEST = False
+    # consecutive non-finite total_loss observations before rolling
+    # back to the last good checkpoint
+    _C.RESILIENCE.NAN_PATIENCE = 3
+    # 0 = observe the loss only where the loop materializes it anyway
+    # (LOG_PERIOD + checkpoint boundaries: zero extra device syncs);
+    # N>0 = force a host read every N steps for a tighter guard
+    _C.RESILIENCE.NAN_CHECK_PERIOD = 0
+    # divergence rollbacks before aborting with a diagnostic
+    _C.RESILIENCE.MAX_ROLLBACKS = 2
+    # hang watchdog: 0 = off; otherwise seconds a step may run before
+    # an all-thread stack report lands in the logdir.  First deadline
+    # is stretched ×WATCHDOG_COMPILE_FACTOR (step 1 includes the XLA
+    # compile, which is slow but not hung).
+    _C.RESILIENCE.WATCHDOG_TIMEOUT_SEC = 0.0
+    _C.RESILIENCE.WATCHDOG_COMPILE_FACTOR = 20.0
+    # bounded retry/backoff around jax.distributed.initialize — JobSet
+    # pods start in arbitrary order and the coordinator may not be
+    # listening yet
+    _C.RESILIENCE.INIT_RETRIES = 5
+    _C.RESILIENCE.INIT_BACKOFF_SEC = 2.0
+    # chaos-ladder hook (tests/test_fault_tolerance.py): at this step,
+    # multiply the params by NaN once — a faithful stand-in for real
+    # divergence (every later loss is non-finite until rollback). 0=off.
+    _C.RESILIENCE.FAULT_INJECT_NAN_STEP = 0
+
     _C.freeze()
 
 

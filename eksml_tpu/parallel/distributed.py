@@ -32,6 +32,13 @@ def initialize_from_env(cfg=None) -> None:
       SLICE_INDEX +        Multislice form: the chart renders one
       PROCS_PER_SLICE +      replicated Job per slice, so the global
       JOB_COMPLETION_INDEX   rank is composed here instead
+
+    JobSet pods start in arbitrary order, so a fast pod can dial a
+    coordinator that is not listening yet — the rendezvous is retried
+    with exponential backoff (RESILIENCE.INIT_RETRIES /
+    INIT_BACKOFF_SEC, or EKSML_INIT_RETRIES / EKSML_INIT_BACKOFF_SEC
+    without a config) and exhaustion surfaces ONE actionable error
+    instead of a bare RPC stack trace.
     """
     global _initialized
     if _initialized:
@@ -40,18 +47,56 @@ def initialize_from_env(cfg=None) -> None:
         coord = cfg.TPU.COORDINATOR_ADDRESS
         nproc = cfg.TPU.NUM_PROCESSES
         pid = cfg.TPU.PROCESS_ID
+        retries = cfg.RESILIENCE.INIT_RETRIES
+        backoff = cfg.RESILIENCE.INIT_BACKOFF_SEC
     else:
         coord = os.environ.get("COORDINATOR_ADDRESS", "")
         nproc = int(os.environ.get("NUM_PROCESSES", "1"))
         pid = _rank_from_env(os.environ)
+        # one source of truth for the retry policy: the RESILIENCE
+        # defaults (env can still override per-pod)
+        from eksml_tpu.config import config as _cfg
+
+        retries = int(os.environ.get(
+            "EKSML_INIT_RETRIES", _cfg.RESILIENCE.INIT_RETRIES))
+        backoff = float(os.environ.get(
+            "EKSML_INIT_BACKOFF_SEC", _cfg.RESILIENCE.INIT_BACKOFF_SEC))
     if nproc <= 1 or not coord:
         log.info("single-process run (NUM_PROCESSES=%s)", nproc)
         return
     log.info("jax.distributed.initialize(%s, num_processes=%d, "
              "process_id=%d)", coord, nproc, pid)
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=nproc, process_id=pid)
+
+    from eksml_tpu.resilience import retry_call
+
+    try:
+        retry_call(
+            lambda: jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nproc,
+                process_id=pid),
+            attempts=retries, backoff_sec=backoff,
+            describe=f"distributed rendezvous with {coord}",
+            cleanup=_shutdown_partial_init)
+    except RuntimeError as e:
+        raise RuntimeError(
+            f"could not rendezvous with the coordinator at {coord} "
+            f"(process_id={pid}, num_processes={nproc}): {e}. "
+            "Check that the JobSet headless Service resolves, that the "
+            "replica-0 pod is Running, and that COORDINATOR_ADDRESS / "
+            "NUM_PROCESSES / PROCESS_ID (or the Multislice SLICE_INDEX "
+            "/ PROCS_PER_SLICE / JOB_COMPLETION_INDEX) env match the "
+            "chart's rendering for every pod.") from e
     _initialized = True
+
+
+def _shutdown_partial_init() -> None:
+    """Best-effort teardown between rendezvous retries: a failed
+    ``initialize`` can leave a half-built client that makes the next
+    attempt fail with 'already initialized' instead of retrying."""
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # nothing was initialized — the common case
+        pass
 
 
 def _rank_from_env(env) -> int:

@@ -1,0 +1,40 @@
+"""In-process resilience layer.
+
+The orchestration layer's whole fault story is "restart the JobSet and
+resume from the latest Orbax step" (charts/maskrcnn failurePolicy +
+Trainer.restore_or_init).  That covers the *lucky* failure — SIGKILL
+with an intact checkpoint directory.  This package owns the unlucky
+ones, one module per pillar:
+
+- :mod:`preemption` — TPU pods get a SIGTERM grace window before the
+  node is reclaimed; convert it into a forced checkpoint at the next
+  step boundary and a distinct "preempted, resumable" exit code the
+  chart's podFailurePolicy maps to restart-not-fail.
+- :mod:`integrity` — a kill mid-commit can leave the newest
+  ``checkpoints/<step>/`` truncated on the shared filesystem; verify
+  per-step manifests at restore and walk back to the newest good step
+  instead of crashing the relaunch.
+- :mod:`sentinel` — a NaN/Inf loss silently poisons every subsequent
+  checkpoint; after K consecutive non-finite observations roll back to
+  the last good checkpoint (the data iterator is NOT rewound, so the
+  offending window is skipped) or abort with a diagnostic.
+- :mod:`watchdog` — a DCN blip hangs a collective forever with zero
+  diagnostics; a heartbeat-backed thread dumps per-thread stacks and
+  the stalled phase when a step exceeds its deadline.
+- :mod:`retry` — bounded retry/backoff used around
+  ``jax.distributed.initialize`` (pods start in arbitrary order).
+
+Knobs live in ``config.RESILIENCE``; the chaos ladder in
+tests/test_fault_tolerance.py and tools/chaos_matrix.sh exercises each
+pillar against a real subprocess trainer.
+"""
+
+from eksml_tpu.resilience.integrity import (  # noqa: F401
+    list_manifest_steps, manifest_path, prune_manifests, quarantine_step,
+    verify_step, write_manifest)
+from eksml_tpu.resilience.preemption import (  # noqa: F401
+    PreemptedError, PreemptionHandler)
+from eksml_tpu.resilience.retry import retry_call  # noqa: F401
+from eksml_tpu.resilience.sentinel import (  # noqa: F401
+    DivergenceError, DivergenceSentinel)
+from eksml_tpu.resilience.watchdog import HangWatchdog  # noqa: F401

@@ -1,0 +1,153 @@
+"""Graceful preemption: SIGTERM → forced checkpoint → resumable exit.
+
+Kubernetes sends SIGTERM and waits ``terminationGracePeriodSeconds``
+before SIGKILL (the chart sizes that window to cover a forced Orbax
+commit).  The handler here only sets a flag — everything unsafe in
+signal context (collectives, checkpoint I/O) happens at the next step
+boundary in the fit loop, which then exits with
+``RESILIENCE.PREEMPT_EXIT_CODE``.  The chart's Job podFailurePolicy
+matches that exit code and the JobSet failurePolicy restarts the world
+without burning a ``maxRestarts`` budget entry (see
+charts/maskrcnn/templates/maskrcnn.yaml).
+
+Multi-host: every pod receives SIGTERM, but delivery is not
+simultaneous and the forced save is a *collective* — if only the hosts
+that have seen the signal entered it, the commit would deadlock.  So
+the local flags are agreed via a tiny cross-host sum every
+``RESILIENCE.PREEMPT_SYNC_PERIOD`` steps; any flagged host makes every
+host checkpoint and exit together.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+#: Default "preempted, resumable" exit code.  77 = EX_NOPERM's
+#: neighborhood is unused by Python/the runtime; must stay in sync with
+#: config.RESILIENCE.PREEMPT_EXIT_CODE and the charts'
+#: maskrcnn.preempt_exit_code (tests/test_orchestration.py pins all
+#: three together).
+DEFAULT_EXIT_CODE = 77
+
+
+class PreemptedError(SystemExit):
+    """Raised at a step boundary after the forced checkpoint committed.
+
+    Subclasses ``SystemExit`` so an uncaught escape still terminates
+    the process with the documented resumable code (no traceback spam
+    in the pod log), while ``train.main`` can catch it for a clean
+    log line first.
+    """
+
+    def __init__(self, exit_code: int, step: int):
+        super().__init__(exit_code)
+        self.exit_code = exit_code
+        self.step = step
+
+
+class PreemptionHandler:
+    """Installable SIGTERM (and optionally SIGINT) flag.
+
+    Usage::
+
+        handler = PreemptionHandler(exit_code=cfg.RESILIENCE.PREEMPT_EXIT_CODE)
+        handler.install()
+        try:
+            ...
+            if handler.should_checkpoint(step, sync_period):
+                ckpt.save(step, state, force=True); ckpt.wait()
+                raise handler.preempted(step)
+        finally:
+            handler.uninstall()
+    """
+
+    def __init__(self, exit_code: int = DEFAULT_EXIT_CODE,
+                 signals=(signal.SIGTERM,)):
+        self.exit_code = exit_code
+        self._signals = tuple(signals)
+        self._flag = threading.Event()
+        self._prev = {}
+        self._installed = False
+        self.signal_time = None
+
+    # -- signal plumbing ----------------------------------------------
+
+    def _on_signal(self, signum, frame):  # noqa: ARG002 (signal API)
+        if not self._flag.is_set():
+            self.signal_time = time.time()
+            # log from signal context is re-entrant-unsafe in theory;
+            # in practice the logging module masks its own locks and
+            # this fires once.  Keep it to one line.
+            log.warning("received signal %d: requesting forced "
+                        "checkpoint at the next step boundary", signum)
+        self._flag.set()
+
+    def install(self) -> "PreemptionHandler":
+        """Install handlers (main thread only — signal module rule).
+        No-op outside the main thread so library users can't crash."""
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            log.warning("PreemptionHandler.install skipped: not on the "
+                        "main thread")
+            return self
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # non-main thread/teardown
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    # -- fit-loop API -------------------------------------------------
+
+    @property
+    def requested(self) -> bool:
+        """This host's local flag (signal seen)."""
+        return self._flag.is_set()
+
+    def request(self) -> None:
+        """Programmatic preemption request (tests, external pollers
+        such as a GCE maintenance-event watcher)."""
+        self._flag.set()
+
+    def should_checkpoint(self, step: int, sync_period: int = 1) -> bool:
+        """Cross-host agreement on "checkpoint now and exit".
+
+        Single-process: the local flag, checked every step.
+        Multi-process: a scalar cross-host sum every ``sync_period``
+        steps — ALL hosts must call this at the same steps (it is a
+        collective), which the fit loop guarantees by calling it
+        unconditionally each step.
+        """
+        import jax
+
+        if jax.process_count() <= 1:
+            return self.requested
+        if sync_period <= 0:
+            sync_period = 1
+        if step % sync_period != 0:
+            return False
+        import jax.numpy as jnp
+
+        from eksml_tpu.parallel.collectives import cross_host_sum
+
+        total = cross_host_sum(
+            {"preempt": jnp.asarray(1.0 if self.requested else 0.0)})
+        return float(total["preempt"]) > 0.0
+
+    def preempted(self, step: int) -> PreemptedError:
+        return PreemptedError(self.exit_code, step)

@@ -1,0 +1,190 @@
+"""Hang watchdog: heartbeat deadline → all-thread stack dump.
+
+The silent failure mode of synchronous SPMD training: one host's DCN
+link blips, a collective never completes, and every process sits in
+``step_fn`` forever — no crash, no log line, nothing for the operator
+to act on until the JobSet's own (much coarser) liveness gives up.
+The reference stack is no better off: a wedged NCCL ring just stops
+the mpirun output (SURVEY.md §5.3).
+
+A daemon thread tracks the last heartbeat the fit loop recorded
+(phase name + step).  When ``deadline_sec`` passes without a beat it
+writes ``<logdir>/hang_report_<n>.txt`` — stalled phase, step, elapsed
+time, per-host identity, and a stack for every live thread — and logs
+an ERROR pointing at it.  It keeps re-arming (a later beat resumes
+normal operation; a persistent hang produces a report every deadline)
+and can optionally escalate through ``on_hang`` after repeated fires.
+
+The first deadline is stretched by ``first_beat_factor`` because step
+one includes the XLA compile (minutes for the full model), which is
+slow but not hung.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class HangWatchdog:
+    def __init__(self, deadline_sec: float, report_dir: str,
+                 first_beat_factor: float = 10.0,
+                 poll_sec: Optional[float] = None,
+                 on_hang: Optional[Callable[[int, str], None]] = None):
+        self.deadline_sec = float(deadline_sec)
+        self.report_dir = report_dir
+        self.first_beat_factor = max(1.0, float(first_beat_factor))
+        self.poll_sec = poll_sec if poll_sec else min(
+            1.0, self.deadline_sec / 4)
+        self.on_hang = on_hang
+        self.fires = 0
+        self.reports = []  # paths written, newest last
+
+        self._lock = threading.Lock()
+        self._phase = "startup"
+        self._step: Optional[int] = None
+        self._last_beat = time.monotonic()
+        self._compile_headroom = True
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "HangWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()  # a stopped watchdog must restart live
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="eksml-hang-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5 * self.poll_sec)
+            if self._thread.is_alive():
+                # stuck mid-dump (stalled logdir?) — keep the handle so
+                # start() refuses to spawn a second watcher alongside
+                # the zombie (which would resume on _stop.clear())
+                log.warning("watchdog thread did not exit in time; "
+                            "restart disabled until it does")
+                return
+            self._thread = None
+
+    def __enter__(self) -> "HangWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- heartbeat ----------------------------------------------------
+
+    def beat(self, phase: str, step: Optional[int] = None) -> None:
+        """Record progress; called by the fit loop at phase edges
+        (next_batch / train_step / checkpoint_save / eval)."""
+        with self._lock:
+            self._phase = phase
+            self._step = step
+            self._last_beat = time.monotonic()
+
+    def end_compile_headroom(self) -> None:
+        """Switch from the stretched first deadline to the steady-state
+        one.  Called by the fit loop AFTER the first jitted step
+        returns — a beat cannot end the headroom, because the loop
+        beats (globalize_batch, train_step) milliseconds before the
+        multi-minute XLA compile it exists to excuse."""
+        with self._lock:
+            self._compile_headroom = False
+            self._last_beat = time.monotonic()
+
+    # -- the watcher --------------------------------------------------
+
+    def _current_deadline(self) -> float:
+        if self._compile_headroom:
+            return self.deadline_sec * self.first_beat_factor
+        return self.deadline_sec
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_sec):
+            with self._lock:
+                elapsed = time.monotonic() - self._last_beat
+                phase, step = self._phase, self._step
+                deadline = self._current_deadline()
+            if elapsed < deadline:
+                continue
+            self.fires += 1
+            try:
+                path = self._dump(phase, step, elapsed)
+                self.reports.append(path)
+                log.error(
+                    "watchdog: no progress for %.1fs (deadline %.1fs) — "
+                    "stalled in phase %r at step %s; all-thread stack "
+                    "report: %s", elapsed, deadline, phase, step, path)
+            except Exception:
+                log.exception("watchdog report failed")
+            if self.on_hang is not None:
+                try:
+                    self.on_hang(self.fires, phase)
+                except Exception:
+                    log.exception("watchdog on_hang callback failed")
+            with self._lock:
+                # re-arm so a persistent hang re-reports every deadline
+                self._last_beat = time.monotonic()
+
+    def _dump(self, phase: str, step, elapsed: float) -> str:
+        os.makedirs(self.report_dir, exist_ok=True)
+        # pid in the name: relaunched incarnations share the logdir and
+        # must not clobber the previous run's post-mortem evidence
+        path = os.path.join(
+            self.report_dir,
+            f"hang_report_{os.getpid()}_{self.fires}.txt")
+        lines = [
+            f"eksml_tpu hang watchdog report #{self.fires}",
+            f"time: {time.strftime('%Y-%m-%d %H:%M:%S %z')}",
+            f"stalled phase: {phase}",
+            f"step: {step}",
+            f"seconds since last heartbeat: {elapsed:.1f}",
+            f"deadline_sec: {self.deadline_sec}",
+            self._host_line(),
+            "",
+        ]
+        frames = sys._current_frames()
+        threads = {t.ident: t for t in threading.enumerate()}
+        for ident, frame in frames.items():
+            t = threads.get(ident)
+            name = t.name if t else f"unknown-{ident}"
+            daemon = getattr(t, "daemon", "?")
+            lines.append(f"--- thread {name} (ident={ident}, "
+                         f"daemon={daemon}) ---")
+            lines.extend(
+                l.rstrip("\n")
+                for l in traceback.format_stack(frame))
+            lines.append("")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return path
+
+    @staticmethod
+    def _host_line() -> str:
+        """Per-host progress identity — which rank's report this is,
+        so a pile of reports from a wedged pod slice can be diffed.
+        Only consults jax when it is ALREADY imported: triggering the
+        multi-second jax import from the watchdog thread would stall
+        the report it exists to produce."""
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                return (f"host: process {jax.process_index()}/"
+                        f"{jax.process_count()}, pid {os.getpid()}")
+            except Exception:
+                pass
+        return f"host: pid {os.getpid()}"

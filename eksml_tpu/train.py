@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import argparse
 import logging
+import math
 import os
+import sys
 import time
 from functools import partial
 from typing import Any, Dict, Iterator, Optional, Tuple
@@ -44,6 +46,9 @@ from eksml_tpu.parallel import (batch_sharding, build_mesh,
                                 initialize_from_env, replicated_sharding,
                                 validate_topology, warm_mesh_collectives)
 from eksml_tpu.parallel.collectives import set_xla_collective_flags
+from eksml_tpu.resilience import (HangWatchdog, PreemptedError,
+                                  PreemptionHandler)
+from eksml_tpu.resilience.sentinel import ROLLBACK, DivergenceSentinel
 from eksml_tpu.utils import CheckpointManager, MetricWriter
 
 log = logging.getLogger("eksml_tpu.train")
@@ -173,7 +178,8 @@ class Trainer:
         self.writer = (MetricWriter(logdir)
                        if write_metrics and jax.process_index() == 0
                        else None)
-        self.ckpt = CheckpointManager(logdir)
+        self.ckpt = CheckpointManager(
+            logdir, digest=cfg.RESILIENCE.CHECKPOINT_DIGEST)
 
         self._batch_sharding = batch_sharding(self.mesh)
         self._state_sharding = replicated_sharding(self.mesh)
@@ -206,16 +212,21 @@ class Trainer:
         return jax.device_put(host, self._state_sharding)
 
     def restore_or_init(self, example_batch) -> Tuple[TrainState, int]:
-        """Auto-resume from the latest Orbax step (the behavior TPU
-        preemption demands; the reference can only rerun by hand,
-        SURVEY.md §5.3)."""
+        """Auto-resume from the newest *verified* Orbax step (the
+        behavior TPU preemption demands; the reference can only rerun
+        by hand, SURVEY.md §5.3).  ``latest_step()`` is not trusted
+        blindly: a kill mid-commit can leave the newest step dir
+        truncated on the shared filesystem, so each candidate is
+        integrity-checked (resilience/integrity.py manifests) and the
+        restore walks back to the newest good step instead of crashing
+        the relaunch."""
         state = self.init_state(example_batch)
-        latest = self.ckpt.latest_step()
-        if latest is not None:
-            log.info("resuming from checkpoint step %d", latest)
-            restored = self.ckpt.restore(state)
-            state = jax.device_put(restored, self._state_sharding)
-            return state, int(np.asarray(state.step))
+        restored = self.ckpt.restore_with_fallback(state)
+        if restored is not None:
+            good, good_step = restored
+            log.info("resuming from checkpoint step %d", good_step)
+            state = jax.device_put(good, self._state_sharding)
+            return state, good_step
         return state, 0
 
     # -- the step ------------------------------------------------------
@@ -241,11 +252,23 @@ class Trainer:
 
     def compiled_step(self):
         if self._jit_step is None:
+            # Donate the state only on accelerator backends.  On
+            # XLA:CPU, device buffers can alias external host memory
+            # (zero-copy device_put, Orbax restore/save references),
+            # and donating such buffers is undefined behavior — the
+            # chaos ladder's restore-then-train rungs hit all three
+            # outcomes: `Check failed: buffer_info.buffer.
+            # IsAvailable()` aborts, glibc heap corruption, and
+            # checkpoints whose bytes were silently clobbered by the
+            # next step.  On TPU the donation is the HBM win that
+            # allows batch-4/chip and the async-save snapshot is a
+            # real D2H copy, so it stays.
+            donate = () if jax.default_backend() == "cpu" else (0,)
             self._jit_step = jax.jit(
                 self._train_step,
                 in_shardings=(self._state_sharding, self._batch_sharding),
                 out_shardings=(self._state_sharding, self._state_sharding),
-                donate_argnums=(0,))
+                donate_argnums=donate)
         return self._jit_step
 
     # -- loop ----------------------------------------------------------
@@ -279,95 +302,266 @@ class Trainer:
         """``profile_steps``: capture a ``jax.profiler`` trace of that
         many post-compile steps into ``<logdir>/profile`` (the
         one-command perf-visibility path, SURVEY.md §5.1 — the
-        reference's only analogue is NCCL_DEBUG=INFO ring dumps)."""
+        reference's only analogue is NCCL_DEBUG=INFO ring dumps).
+
+        Resilience wiring (eksml_tpu/resilience/, knobs under
+        ``config.RESILIENCE``): SIGTERM forces a checkpoint at the next
+        step boundary and exits with the resumable code; non-finite
+        losses roll back to the last good checkpoint and never reach
+        ``ckpt.save``; a heartbeat watchdog dumps all-thread stacks
+        when a step exceeds its deadline."""
         cfg = self.cfg
+        res = cfg.RESILIENCE
         step_fn = None
         profile_until = None
         t_last = time.time()
+        steps_since_log = 0
         steps_per_epoch = cfg.TRAIN.STEPS_PER_EPOCH
         ckpt_every = max(1, cfg.TRAIN.CHECKPOINT_PERIOD) * steps_per_epoch
         eval_every = max(1, cfg.TRAIN.EVAL_PERIOD) * steps_per_epoch
         imgs_per_step = (cfg.TRAIN.BATCH_SIZE_PER_CHIP *
                          max(1, cfg.TRAIN.NUM_CHIPS))
 
+        preempt = None
+        if res.GRACEFUL_SHUTDOWN:
+            preempt = PreemptionHandler(
+                exit_code=res.PREEMPT_EXIT_CODE).install()
+        watchdog = None
+        if res.WATCHDOG_TIMEOUT_SEC > 0:
+            watchdog = HangWatchdog(
+                res.WATCHDOG_TIMEOUT_SEC, report_dir=self.logdir,
+                first_beat_factor=res.WATCHDOG_COMPILE_FACTOR).start()
+        sentinel = DivergenceSentinel(patience=res.NAN_PATIENCE,
+                                      max_rollbacks=res.MAX_ROLLBACKS)
+        nan_injected = False
+
         step = start_step
-        for batch in batches:
-            device_batch = self._globalize_batch(batch)
-            if state is None:
-                state, step = self.restore_or_init(device_batch)
+        try:
+            for batch in batches:
+                if watchdog:
+                    watchdog.beat("globalize_batch", step)
+                device_batch = self._globalize_batch(batch)
+                if state is None:
+                    state, step = self.restore_or_init(device_batch)
+                    if step >= total_steps:
+                        break
+                first_call = step_fn is None
+                if first_call:
+                    step_fn = self.compiled_step()
+                if watchdog:
+                    watchdog.beat("train_step", step + 1)
+                state, metrics = step_fn(state, device_batch)
+                if watchdog and first_call:
+                    # the compile happened inside that call; from here
+                    # the steady-state deadline applies
+                    watchdog.end_compile_headroom()
+                step += 1
+                steps_since_log += 1
+
+                if (res.FAULT_INJECT_NAN_STEP and not nan_injected
+                        and step == res.FAULT_INJECT_NAN_STEP):
+                    # chaos-ladder hook: poison the params ONCE — from
+                    # here every loss is non-finite until the sentinel
+                    # rolls back, exactly like a real divergence
+                    nan_injected = True
+                    log.warning("chaos: injecting NaN into params at "
+                                "step %d (RESILIENCE.FAULT_INJECT_"
+                                "NAN_STEP)", step)
+                    state = state.replace(params=jax.tree.map(
+                        lambda x: x * jnp.asarray(jnp.nan, x.dtype),
+                        state.params))
+
+                if (profile_steps and profile_until is None
+                        and jax.process_index() == 0):
+                    # first step (compile) done — trace steady-state steps
+                    jax.block_until_ready(metrics["total_loss"])
+                    jax.profiler.start_trace(
+                        os.path.join(self.logdir, "profile"))
+                    profile_until = step + profile_steps
+                elif profile_until is not None and step >= profile_until:
+                    jax.block_until_ready(metrics["total_loss"])
+                    jax.profiler.stop_trace()
+                    log.info("profiler trace written to %s/profile",
+                             self.logdir)
+                    profile_until = None
+                    profile_steps = 0
+
+                log_step = (step % cfg.TRAIN.LOG_PERIOD == 0
+                            or step == total_steps)
+                ckpt_step = (step % ckpt_every == 0
+                             or step == total_steps)
+                # Divergence sentinel: observe the loss wherever the
+                # loop materializes it anyway (log/checkpoint
+                # boundaries), or every NAN_CHECK_PERIOD steps when the
+                # operator buys a tighter guard with one device sync
+                # per check.  A checkpoint boundary ALWAYS observes —
+                # non-finite state must never reach ckpt.save.
+                period = res.NAN_CHECK_PERIOD
+                if (ckpt_step or (period > 0 and step % period == 0)
+                        or (period == 0 and log_step)):
+                    action = sentinel.observe(
+                        step, float(np.asarray(metrics["total_loss"])))
+                    if action == ROLLBACK:
+                        state, step = self._rollback(sentinel, state,
+                                                     step)
+                        steps_since_log = 0
+                        t_last = time.time()
+                        continue
+
+                if log_step:
+                    metrics = jax.tree.map(lambda x: float(np.asarray(x)),
+                                           metrics)
+                    dt = time.time() - t_last
+                    t_last = time.time()
+                    # normalize by the steps actually covered since the
+                    # last log — the final step lands off the
+                    # LOG_PERIOD boundary, where assuming a full period
+                    # overstated throughput
+                    metrics["images_per_sec"] = (
+                        imgs_per_step * steps_since_log / max(dt, 1e-9))
+                    steps_since_log = 0
+                    if self.writer:
+                        self.writer.write_scalars(step, metrics)
+                    log.info("step %d/%d loss=%.4f (%.1f img/s)", step,
+                             total_steps, metrics["total_loss"],
+                             metrics["images_per_sec"])
+
+                sync_every = cfg.TRAIN.SYNC_CHECK_PERIOD
+                if sync_every and step % sync_every == 0:
+                    from eksml_tpu.parallel.collectives import \
+                        assert_replicas_in_sync
+
+                    assert_replicas_in_sync(state.params, self.mesh,
+                                            rng=state.rng)
+
+                if ckpt_step:
+                    if not sentinel.allows_save():
+                        log.warning(
+                            "skipping checkpoint at step %d: last "
+                            "observed total_loss is non-finite "
+                            "(divergence sentinel)", step)
+                    else:
+                        # hand Orbax the sharded jax arrays directly:
+                        # async checkpointing snapshots to host (brief
+                        # blocking D2H) and persists in a background
+                        # thread.  Materializing to numpy first
+                        # (round 1) forced the full write onto the
+                        # step loop.  Donation is safe — the snapshot
+                        # completes before save() returns.
+                        if watchdog:
+                            watchdog.beat("checkpoint_save", step)
+                        t_save = time.time()
+                        self.ckpt.save(step, state)
+                        if self.writer:
+                            self.writer.write_scalars(step, {
+                                "checkpoint_save_ms":
+                                    (time.time() - t_save) * 1000})
+                if self.eval_fn and (step % eval_every == 0
+                                     or step == total_steps):
+                    if watchdog:
+                        watchdog.beat("eval", step)
+                    self._run_eval(state, step)
+
+                # graceful preemption: every host polls at the same
+                # steps (the poll is a collective in multi-host) so a
+                # SIGTERM on ANY host makes ALL hosts commit a forced
+                # checkpoint together and exit resumable
+                if preempt is not None and preempt.should_checkpoint(
+                        step,
+                        res.PREEMPT_SYNC_PERIOD or cfg.TRAIN.LOG_PERIOD):
+                    self._graceful_exit(preempt, metrics, state, step)
+
                 if step >= total_steps:
                     break
-            if step_fn is None:
-                step_fn = self.compiled_step()
-            state, metrics = step_fn(state, device_batch)
-            step += 1
-
-            if (profile_steps and profile_until is None
-                    and jax.process_index() == 0):
-                # first step (compile) done — trace steady-state steps
-                jax.block_until_ready(metrics["total_loss"])
-                jax.profiler.start_trace(
-                    os.path.join(self.logdir, "profile"))
-                profile_until = step + profile_steps
-            elif profile_until is not None and step >= profile_until:
-                jax.block_until_ready(metrics["total_loss"])
+                if watchdog:
+                    watchdog.beat("next_batch", step)
+        finally:
+            if profile_until is not None:
+                # run ended before profile_steps elapsed — close the
+                # trace so it still lands (and a later start_trace
+                # won't raise)
                 jax.profiler.stop_trace()
-                log.info("profiler trace written to %s/profile",
-                         self.logdir)
-                profile_until = None
-                profile_steps = 0
-
-            if step % cfg.TRAIN.LOG_PERIOD == 0 or step == total_steps:
-                metrics = jax.tree.map(lambda x: float(np.asarray(x)),
-                                       metrics)
-                dt = time.time() - t_last
-                t_last = time.time()
-                metrics["images_per_sec"] = (
-                    imgs_per_step * cfg.TRAIN.LOG_PERIOD / max(dt, 1e-9))
+                log.info("profiler trace (truncated run) written to "
+                         "%s/profile", self.logdir)
+            if watchdog:
+                watchdog.stop()
+            if preempt is not None:
+                preempt.uninstall()
+            # always drain the async checkpoint thread and buffered
+            # metrics — an exception mid-loop must not abandon an
+            # in-flight save or lose the last metric rows.  A drain
+            # failure is swallowed ONLY while another exception is
+            # already propagating (it must not mask where training
+            # actually died); on the clean path it raises, so a failed
+            # final commit cannot masquerade as a successful run.
+            propagating = sys.exc_info()[0] is not None
+            try:
+                self.ckpt.wait()
                 if self.writer:
-                    self.writer.write_scalars(step, metrics)
-                log.info("step %d/%d loss=%.4f (%.1f img/s)", step,
-                         total_steps, metrics["total_loss"],
-                         metrics["images_per_sec"])
-
-            sync_every = cfg.TRAIN.SYNC_CHECK_PERIOD
-            if sync_every and step % sync_every == 0:
-                from eksml_tpu.parallel.collectives import \
-                    assert_replicas_in_sync
-
-                assert_replicas_in_sync(state.params, self.mesh,
-                                        rng=state.rng)
-
-            if step % ckpt_every == 0 or step == total_steps:
-                # hand Orbax the sharded jax arrays directly: async
-                # checkpointing snapshots to host (brief blocking D2H)
-                # and persists in a background thread.  Materializing
-                # to numpy first (round 1) forced the full write onto
-                # the step loop.  Donation is safe — the snapshot
-                # completes before save() returns.
-                t_save = time.time()
-                self.ckpt.save(step, state)
-                if self.writer:
-                    self.writer.write_scalars(step, {
-                        "checkpoint_save_ms":
-                            (time.time() - t_save) * 1000})
-            if self.eval_fn and (step % eval_every == 0
-                                 or step == total_steps):
-                self._run_eval(state, step)
-            if step >= total_steps:
-                break
-
-        if profile_until is not None:
-            # run ended before profile_steps elapsed — close the trace
-            # so it still lands (and a later start_trace won't raise)
-            jax.profiler.stop_trace()
-            log.info("profiler trace (truncated run) written to "
-                     "%s/profile", self.logdir)
-
-        self.ckpt.wait()
-        if self.writer:
-            self.writer.flush()
+                    self.writer.flush()
+            except Exception:
+                if not propagating:
+                    raise
+                log.exception("draining checkpoint/metrics state "
+                              "during shutdown failed (keeping the "
+                              "original exception)")
         return state
+
+    def _rollback(self, sentinel: DivergenceSentinel, state: TrainState,
+                  step: int) -> Tuple[TrainState, int]:
+        """Divergence recovery: restore the newest verified checkpoint
+        and continue from there.  The data iterator is NOT rewound, so
+        the re-run consumes fresh batches — the window that fed the
+        divergence is skipped.  Raises DivergenceError when there is
+        nothing to restore or the rollback budget is spent."""
+        restored = self.ckpt.restore_with_fallback(state)
+        if restored is None:
+            raise sentinel.no_checkpoint_to_restore(step)
+        good, good_step = restored
+        sentinel.register_rollback(step, good_step)
+        if self.writer:
+            self.writer.write_scalars(
+                good_step, {"resilience/rollback_from": float(step)})
+        return jax.device_put(good, self._state_sharding), good_step
+
+    def _graceful_exit(self, preempt: PreemptionHandler,
+                       metrics: Dict, state: TrainState,
+                       step: int) -> None:
+        """SIGTERM grace window: commit a forced checkpoint (unless
+        the state is non-finite), flush metrics, and exit with the
+        documented resumable code — the chart's podFailurePolicy maps
+        it to restart-not-fail, so the relaunch loses at most the
+        in-flight step.  The finiteness check reads THIS step's loss
+        (one device sync — the process is exiting anyway) rather than
+        the sentinel's possibly steps-old observation, so a recovered
+        blip cannot block the forced save."""
+        # land any in-flight periodic commit first; if THIS step was
+        # just checkpointed in the same iteration, a forced re-save
+        # would delete and rewrite it — doubling the commit cost the
+        # grace window was sized for and briefly unprotecting a good
+        # checkpoint
+        self.ckpt.wait()
+        if self.ckpt.latest_step() == step:
+            log.warning("preemption: step %d already committed; "
+                        "exiting resumable (code %d)", step,
+                        preempt.exit_code)
+        elif math.isfinite(float(np.asarray(metrics["total_loss"]))):
+            log.warning("preemption: forcing checkpoint at step %d",
+                        step)
+            self.ckpt.save(step, state, force=True)
+            self.ckpt.wait()
+            log.warning("preemption: checkpoint at step %d committed; "
+                        "exiting resumable (code %d)", step,
+                        preempt.exit_code)
+        else:
+            log.warning("preemption: last observed loss non-finite — "
+                        "NOT committing a poisoned checkpoint; exiting "
+                        "resumable (code %d)", preempt.exit_code)
+        if self.writer:
+            self.writer.write_scalars(
+                step, {"resilience/preempted": 1.0})
+            self.writer.flush()
+        raise preempt.preempted(step)
 
     def _run_eval(self, state, step):
         try:
@@ -404,8 +598,12 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    # force=True: the site hook pre-imports jax, and anything that
+    # installed a root handler on the way makes a plain basicConfig a
+    # silent no-op — dropping every INFO diagnostic (resume step,
+    # integrity fallbacks, "training complete") from the pod log
     logging.basicConfig(
-        level=logging.INFO,
+        level=logging.INFO, force=True,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     # explicit platform pin (e.g. EKSML_PLATFORM=cpu for the run.sh
     # smoke on a host whose site config pre-selects an accelerator)
@@ -466,9 +664,36 @@ def main(argv=None):
     total_steps = (args.total_steps if args.total_steps is not None
                    else cfg.TRAIN.STEPS_PER_EPOCH * cfg.TRAIN.MAX_EPOCHS)
 
-    trainer.fit(loader.batches(None), total_steps,
-                profile_steps=args.profile)
-    log.info("training complete at %d steps", total_steps)
+    try:
+        trainer.fit(loader.batches(None), total_steps,
+                    profile_steps=args.profile)
+    except PreemptedError as e:
+        log.warning("preempted at step %d: exiting with resumable "
+                    "code %d (JobSet restarts without burning a "
+                    "maxRestarts entry; relaunch auto-resumes)",
+                    e.step, e.exit_code)
+        raise  # SystemExit subclass: the process exits with the code
+    else:
+        log.info("training complete at %d steps", total_steps)
+    finally:
+        # ALWAYS shut Orbax's background threads down before
+        # interpreter teardown — a live async-save thread at
+        # Py_Finalize is a flaky shutdown crash, and on the preemption
+        # path a teardown crash would replace the documented resumable
+        # exit code with a signal death the chart counts as a genuine
+        # failure.  A close() error is swallowed only while an
+        # exception (incl. PreemptedError) is already propagating —
+        # the exit status must stay what that exception says; on the
+        # clean path it raises, so a failed final commit surfaces.
+        propagating = sys.exc_info()[0] is not None
+        try:
+            trainer.ckpt.close()
+        except Exception:
+            if not propagating:
+                raise
+            log.exception("checkpoint manager close failed during "
+                          "shutdown (keeping the original exit "
+                          "status)")
 
 
 if __name__ == "__main__":

@@ -7,23 +7,40 @@ filename-glob "latest" discovery the notebooks do (viz notebook cell 7).
 Orbax gives atomic multi-host writes and ``latest_step()`` natively;
 auto-resume-from-latest on re-entry is the behavior TPU preemption
 requires (SURVEY.md §5.3).
+
+Integrity layer (eksml_tpu/resilience/integrity.py): after each async
+commit the coordinator writes a per-step manifest (file sizes, optional
+sha256) under ``checkpoints/.integrity/``; on restore the manager
+verifies the newest step against its manifest and *walks back* to the
+newest good one instead of crashing the relaunch — a kill mid-commit on
+NFS/FUSE can leave a renamed-but-truncated step dir that
+``latest_step()`` alone would trust blindly.
 """
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
+from eksml_tpu.resilience import integrity
+
+log = logging.getLogger(__name__)
 
 class CheckpointManager:
     """Thin wrapper over ``ocp.CheckpointManager`` with a stable
     directory contract: ``<logdir>/checkpoints/<step>/``."""
 
-    def __init__(self, logdir: str, max_to_keep: int = 5):
+    def __init__(self, logdir: str, max_to_keep: int = 5,
+                 digest: bool = False):
         self.directory = os.path.join(os.path.abspath(logdir), "checkpoints")
+        self.digest = digest
+        # steps whose async save may still be in flight; manifests are
+        # written once the commit is known finished
+        self._manifest_pending: set = set()
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -31,12 +48,47 @@ class CheckpointManager:
                 enable_async_checkpointing=True),
         )
 
+    # -- save ----------------------------------------------------------
+
     def save(self, step: int, state: Any, force: bool = False) -> bool:
-        return self._mngr.save(
+        saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force)
+        if saved:
+            # Orbax serialized save N before starting N+1, so every
+            # previously pending step is committed by now — publish
+            # its manifest before tracking the new in-flight one.
+            self._write_pending_manifests(exclude=step)
+            self._manifest_pending.add(step)
+        return saved
+
+    def _write_pending_manifests(self, exclude: Optional[int] = None) -> None:
+        """Publish manifests for pending steps whose commit finished.
+        Coordinator-only: every host shares the filesystem, and the
+        manifest must describe the COMPLETE multi-host commit."""
+        if not self._manifest_pending:
+            return
+        committed = set(self.all_steps())
+        done = {s for s in self._manifest_pending
+                if s in committed and s != exclude}
+        if jax.process_index() == 0:
+            for s in sorted(done):
+                try:
+                    integrity.write_manifest(self.directory, s,
+                                             digest=self.digest)
+                except OSError:
+                    log.exception("manifest write failed for step %d", s)
+            integrity.prune_manifests(self.directory, committed)
+        self._manifest_pending -= done
+
+    # -- discovery -----------------------------------------------------
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
+
+    def all_steps(self) -> List[int]:
+        return sorted(self._mngr.all_steps())
+
+    # -- restore -------------------------------------------------------
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of ``state_like``."""
@@ -47,9 +99,154 @@ class CheckpointManager:
         return self._mngr.restore(
             step, args=ocp.args.StandardRestore(abstract))
 
+    def restore_with_fallback(
+            self, state_like: Any) -> Optional[Tuple[Any, int]]:
+        """Restore the newest step that passes integrity verification
+        AND deserializes; walk back through older steps on failure.
+
+        Returns ``(state, step)`` or ``None`` when no step is
+        restorable (caller starts fresh).  Corrupt steps are
+        quarantined (renamed out of the digit namespace) so a re-run
+        of that step can commit cleanly and later relaunches skip the
+        scan.  Quarantine requires *corruption evidence*: a failed
+        verification, or a failed restore of a step that had no
+        manifest to verify against.  A step that verified intact
+        against its manifest but still fails to deserialize points at
+        a systematic problem (changed TrainState structure, sharding,
+        or topology) — that raises instead of walking back, because
+        quarantining would destroy every good checkpoint one by one
+        and silently restart training from scratch.
+        """
+        # land any in-flight commit and its manifest first, so an
+        # in-run rollback verifies against the manifest instead of
+        # falling back to the structural check
+        self._mngr.wait_until_finished()
+        self._write_pending_manifests()
+        tried = set()
+        while True:
+            step = self._agreed_candidate()
+            if step is None:
+                return None
+            if step in tried:
+                # quarantine could not move the step aside (EROFS /
+                # ESTALE on the shared fs) — without this cap the
+                # walk-back would spin on it forever
+                raise RuntimeError(
+                    f"checkpoint step {step} keeps failing restore and "
+                    f"could not be quarantined — giving up instead of "
+                    "looping. Inspect/remove "
+                    f"{os.path.join(self.directory, str(step))} "
+                    "manually.")
+            tried.add(step)
+            out, err = None, None
+            try:
+                out = self.restore(state_like, step)
+            except Exception as e:  # deserialization = last defense
+                err = e
+            # the restore outcome needs the same cross-host agreement
+            # as the candidate choice: a stale-NFS-handle failure on
+            # ONE host must send EVERY host around the walk-back loop
+            # together, or the lone failing host blocks forever in the
+            # next broadcast while the others train
+            if self._agreed_ok(err is None):
+                return out, step
+            # the raise-vs-walk-back verdict must ALSO be one
+            # decision for all hosts: per-host manifest visibility
+            # (NFS attribute-cache lag) could send one host into the
+            # raise while the rest loop back into a collective.
+            # "manifest readable" (exists AND parses), not merely
+            # present: a kill mid-flush truncates manifests too, and a
+            # truncated manifest is corruption evidence, not proof of
+            # intactness
+            if self._coordinator_says(integrity.manifest_readable(
+                    self.directory, step)):
+                raise RuntimeError(
+                    f"checkpoint step {step} verified intact against "
+                    f"its integrity manifest but failed to "
+                    f"deserialize ({err}). This is a systematic "
+                    "restore failure (changed TrainState structure, "
+                    "optimizer, sharding or topology?), not "
+                    "corruption — refusing to quarantine verified "
+                    "checkpoints. Fix the mismatch or restore an "
+                    "explicit step.")
+            log.warning("checkpoint restore of step %d failed on at "
+                        "least one host (local error: %s) — falling "
+                        "back to an earlier step", step, err)
+            self._quarantine(step)
+
+    @staticmethod
+    def _agreed_ok(local_ok: bool) -> bool:
+        """True iff EVERY host's flag is true (identity when
+        single-process)."""
+        if jax.process_count() <= 1:
+            return local_ok
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.int32(1 if local_ok else 0))
+        return bool(np.min(flags) == 1)
+
+    @staticmethod
+    def _coordinator_says(local_flag: bool) -> bool:
+        """The coordinator's view of a shared-filesystem fact,
+        broadcast so every host takes the same branch (identity when
+        single-process)."""
+        if jax.process_count() <= 1:
+            return local_flag
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        return bool(int(multihost_utils.broadcast_one_to_all(
+            np.int32(1 if local_flag else 0))))
+
+    def _agreed_candidate(self) -> Optional[int]:
+        """Newest integrity-verified step, agreed across hosts.
+
+        The coordinator scans (and quarantines what fails); every other
+        host follows its verdict via a broadcast.  Per-host verdicts
+        could disagree — NFS attribute caches lag renames — and the
+        multi-host Orbax restore is a collective, so two hosts entering
+        it at different steps deadlocks the relaunch."""
+        step = -1
+        if jax.process_index() == 0:
+            for s in sorted(self.all_steps(), reverse=True):
+                ok, reason = integrity.verify_step(self.directory, s)
+                if ok:
+                    log.info("checkpoint integrity: %s", reason)
+                    step = s
+                    break
+                log.warning("checkpoint integrity: %s — falling back "
+                            "to an earlier step", reason)
+                self._quarantine(s)
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            step = int(multihost_utils.broadcast_one_to_all(
+                np.int32(step)))
+            self._reload()  # coordinator may have renamed dirs under us
+        return None if step < 0 else step
+
+    def _quarantine(self, step: int) -> None:
+        if jax.process_index() == 0:
+            integrity.quarantine_step(self.directory, step)
+        self._reload()
+
+    def _reload(self) -> None:
+        """Drop the manager's cached step list after the directory
+        changed under it (quarantine rename)."""
+        try:
+            self._mngr.reload()
+        except Exception:
+            log.debug("orbax manager reload failed", exc_info=True)
+
+    # -- lifecycle -----------------------------------------------------
+
     def wait(self) -> None:
         self._mngr.wait_until_finished()
+        self._write_pending_manifests()
 
     def close(self) -> None:
-        self._mngr.wait_until_finished()
+        self.wait()
         self._mngr.close()

@@ -1,0 +1,180 @@
+"""Direct unit tests for the JobSet rendezvous layer
+(eksml_tpu/parallel/distributed.py) — rank composition, the
+partial-env fail-fast, and the retry/backoff wrap around
+``jax.distributed.initialize``.
+
+The e2e half (two real processes rendezvousing over a socket) lives in
+tests/test_multiprocess.py; the chart-side rendering of the same env
+contract is asserted in tests/test_orchestration.py.  These tests pin
+the pure logic so a regression is caught without either harness.
+"""
+
+import pytest
+
+import eksml_tpu.parallel.distributed as dist
+from eksml_tpu.parallel.distributed import _rank_from_env
+
+
+# ---- rank composition ------------------------------------------------
+
+
+def test_single_slice_process_id_is_the_rank():
+    assert _rank_from_env({"PROCESS_ID": "0"}) == 0
+    assert _rank_from_env({"PROCESS_ID": "7"}) == 7
+
+
+def test_process_id_wins_over_multislice_env():
+    """A chart that renders both forms is ambiguous; the explicit
+    PROCESS_ID is the documented tiebreak (single-slice contract)."""
+    assert _rank_from_env({"PROCESS_ID": "3", "SLICE_INDEX": "9",
+                           "PROCS_PER_SLICE": "4",
+                           "JOB_COMPLETION_INDEX": "1"}) == 3
+
+
+@pytest.mark.parametrize("slices,procs", [(2, 4), (4, 2), (3, 1)])
+def test_multislice_composition_is_slice_major(slices, procs):
+    """Global rank = SLICE_INDEX·PROCS_PER_SLICE + JOB_COMPLETION_INDEX
+    must enumerate 0..N-1 slice-major — the same device order
+    build_mesh uses, or data shards land on the wrong hosts."""
+    ranks = [_rank_from_env({"SLICE_INDEX": str(s),
+                             "PROCS_PER_SLICE": str(procs),
+                             "JOB_COMPLETION_INDEX": str(i)})
+             for s in range(slices) for i in range(procs)]
+    assert ranks == list(range(slices * procs))
+
+
+def test_multislice_missing_completion_index_defaults_to_zero():
+    # parallelism=1 Jobs render no completion index; pod 0 of slice 2
+    assert _rank_from_env({"SLICE_INDEX": "2",
+                           "PROCS_PER_SLICE": "1"}) == 2
+
+
+def test_plain_indexed_job_falls_back_to_completion_index():
+    assert _rank_from_env({"JOB_COMPLETION_INDEX": "5"}) == 5
+    assert _rank_from_env({}) == 0
+
+
+def test_partial_multislice_env_fails_fast():
+    """SLICE_INDEX without PROCS_PER_SLICE must raise, not silently
+    return the per-slice completion index — colliding ranks across
+    slices hangs rendezvous with no diagnostic (ADVICE r3)."""
+    with pytest.raises(RuntimeError, match="PROCS_PER_SLICE"):
+        _rank_from_env({"SLICE_INDEX": "1", "JOB_COMPLETION_INDEX": "2"})
+
+
+def test_config_from_env_composes_the_same_rank(monkeypatch):
+    """config_from_env and initialize_from_env must agree on the rank
+    definition (one source of truth: _rank_from_env)."""
+    from eksml_tpu.config import config, config_from_env
+
+    for k in ("PROCESS_ID", "SLICE_INDEX", "PROCS_PER_SLICE",
+              "JOB_COMPLETION_INDEX", "COORDINATOR_ADDRESS",
+              "NUM_PROCESSES"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SLICE_INDEX", "1")
+    monkeypatch.setenv("PROCS_PER_SLICE", "4")
+    monkeypatch.setenv("JOB_COMPLETION_INDEX", "2")
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "c:8476")
+    monkeypatch.setenv("NUM_PROCESSES", "8")
+    saved = config.to_dict()
+    try:
+        cfg = config_from_env(config)
+        assert cfg.TPU.PROCESS_ID == 6
+        assert cfg.TPU.NUM_PROCESSES == 8
+        assert cfg.TPU.COORDINATOR_ADDRESS == "c:8476"
+    finally:
+        config.freeze(False)
+        config.from_dict(saved)
+        config.freeze()
+
+
+# ---- initialize_from_env retry/backoff -------------------------------
+
+
+@pytest.fixture()
+def fresh_rendezvous(monkeypatch):
+    """Un-latch the module's idempotency flag and give the test its own
+    fake jax.distributed (attempt/cleanup counters)."""
+    monkeypatch.setattr(dist, "_initialized", False)
+
+    class FakeDistributed:
+        def __init__(self):
+            self.attempts = 0
+            self.shutdowns = 0
+            self.fail_first = 0
+            self.kwargs = None
+
+        def initialize(self, **kwargs):
+            self.attempts += 1
+            self.kwargs = kwargs
+            if self.attempts <= self.fail_first:
+                raise ConnectionError("connection refused")
+
+        def shutdown(self):
+            self.shutdowns += 1
+
+    fake = FakeDistributed()
+    monkeypatch.setattr(dist.jax, "distributed", fake)
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "coord-0:8476")
+    monkeypatch.setenv("NUM_PROCESSES", "2")
+    monkeypatch.setenv("PROCESS_ID", "1")
+    monkeypatch.setenv("EKSML_INIT_RETRIES", "3")
+    monkeypatch.setenv("EKSML_INIT_BACKOFF_SEC", "0.01")
+    return fake
+
+
+@pytest.mark.chaos
+def test_initialize_retries_a_slow_coordinator(fresh_rendezvous):
+    """Pods start in arbitrary order: two refused dials then success
+    must initialize (and tear down the half-built client between
+    attempts), not kill the pod."""
+    fresh_rendezvous.fail_first = 2
+    dist.initialize_from_env()
+    assert fresh_rendezvous.attempts == 3
+    assert fresh_rendezvous.shutdowns == 2  # cleanup between attempts
+    assert fresh_rendezvous.kwargs == dict(
+        coordinator_address="coord-0:8476", num_processes=2, process_id=1)
+    assert dist._initialized
+
+
+@pytest.mark.chaos
+def test_initialize_exhaustion_is_one_actionable_error(fresh_rendezvous):
+    fresh_rendezvous.fail_first = 10 ** 9
+    with pytest.raises(RuntimeError) as ei:
+        dist.initialize_from_env()
+    msg = str(ei.value)
+    # names the coordinator, the rank identity, and what to check
+    assert "coord-0:8476" in msg
+    assert "process_id=1" in msg
+    assert "headless Service" in msg and "COORDINATOR_ADDRESS" in msg
+    assert fresh_rendezvous.attempts == 3
+    assert not dist._initialized
+
+
+def test_initialize_noop_when_single_process(fresh_rendezvous,
+                                             monkeypatch):
+    monkeypatch.setenv("NUM_PROCESSES", "1")
+    dist.initialize_from_env()
+    assert fresh_rendezvous.attempts == 0
+
+
+def test_initialize_reads_retry_knobs_from_config(fresh_rendezvous):
+    from eksml_tpu.config import config
+
+    fresh_rendezvous.fail_first = 10 ** 9
+    saved = config.to_dict()
+    config.freeze(False)
+    try:
+        config.TPU.COORDINATOR_ADDRESS = "cfg-coord:1"
+        config.TPU.NUM_PROCESSES = 2
+        config.TPU.PROCESS_ID = 0
+        config.RESILIENCE.INIT_RETRIES = 2
+        config.RESILIENCE.INIT_BACKOFF_SEC = 0.01
+        config.freeze()
+        with pytest.raises(RuntimeError, match="cfg-coord:1"):
+            dist.initialize_from_env(config)
+        assert fresh_rendezvous.attempts == 2
+    finally:
+        config.freeze(False)
+        config.from_dict(saved)
+        config.freeze()

@@ -223,6 +223,94 @@ def test_jobset_chart_topologies_match_runtime_inventory():
     assert set(vals["topologies"]) == set(TOPOLOGIES)
 
 
+# ---- resilience: preemption contract in the rendered manifests ------
+# The in-process half (eksml_tpu/resilience/preemption.py) exits the
+# documented "preempted, resumable" code after its forced checkpoint;
+# the chart half must (1) give the pod a grace window long enough for
+# the forced commit, (2) map exactly that exit code to a Job failure
+# with reason PodFailurePolicy, and (3) map that reason to a JobSet
+# restart that does NOT burn a maxRestarts entry.  Any drift between
+# the three layers silently turns routine preemption into job death.
+
+
+@pytest.mark.parametrize("chart", ["charts/maskrcnn",
+                                   "charts/maskrcnn-optimized"])
+def test_termination_grace_period_from_values(chart):
+    vals = yaml.safe_load(_read(f"{chart}/values.yaml"))["maskrcnn"]
+    tmpl = _read(f"{chart}/templates/maskrcnn.yaml")
+    assert ("terminationGracePeriodSeconds: "
+            "{{ int .Values.maskrcnn.termination_grace_period_seconds }}"
+            ) in tmpl
+    # long enough for a forced Orbax commit of the full model to a
+    # shared filesystem; the k8s default of 30s is not
+    assert vals["termination_grace_period_seconds"] >= 120
+    schema = json.loads(_read(f"{chart}/values.schema.json"))
+    prop = schema["properties"]["maskrcnn"]["properties"][
+        "termination_grace_period_seconds"]
+    assert prop["type"] == "integer" and prop["minimum"] >= 30
+
+
+@pytest.mark.parametrize("chart", ["charts/maskrcnn",
+                                   "charts/maskrcnn-optimized"])
+def test_preempt_exit_code_maps_to_restart_not_fail(chart):
+    tmpl = _read(f"{chart}/templates/maskrcnn.yaml")
+    # Job level: the resumable exit code fails the Job with reason
+    # PodFailurePolicy (requires restartPolicy Never, which the pod
+    # spec keeps)
+    assert "podFailurePolicy:" in tmpl
+    assert "action: FailJob" in tmpl
+    assert "containerName: train" in tmpl
+    assert ("values: [{{ int .Values.maskrcnn.preempt_exit_code }}]"
+            in tmpl)
+    assert "restartPolicy: Never" in tmpl
+    # preemptions that never record the exit code (eviction, grace
+    # window overrun -> SIGKILL) route through DisruptionTarget to the
+    # same restart-not-fail path; FailJob, not Ignore — a lone
+    # recreated pod cannot rejoin an SPMD rendezvous mid-flight
+    assert "type: DisruptionTarget" in tmpl
+    # JobSet level: that reason restarts the world without consuming
+    # the genuine-failure budget
+    assert "action: RestartJobSetAndIgnoreMaxRestarts" in tmpl
+    assert "- PodFailurePolicy" in tmpl
+    assert "maxRestarts: {{ .Values.maskrcnn.max_restarts }}" in tmpl
+    vals = yaml.safe_load(_read(f"{chart}/values.yaml"))["maskrcnn"]
+    assert vals["max_restarts"] >= 1
+
+
+@pytest.mark.parametrize("chart", ["charts/maskrcnn",
+                                   "charts/maskrcnn-optimized"])
+def test_preempt_exit_code_matches_runtime_default(chart):
+    """values.yaml, the rendered --config argv, and the runtime default
+    must agree on ONE exit code — the podFailurePolicy matches a
+    literal value, so drift would classify graceful preemption as a
+    genuine failure (or vice versa)."""
+    from eksml_tpu.config import config as cfg
+
+    vals = yaml.safe_load(_read(f"{chart}/values.yaml"))["maskrcnn"]
+    assert vals["preempt_exit_code"] == cfg.RESILIENCE.PREEMPT_EXIT_CODE
+    # the chart passes its value through to the trainer, so even a
+    # values override cannot desynchronize the two layers
+    tmpl = _read(f"{chart}/templates/maskrcnn.yaml")
+    assert ("RESILIENCE.PREEMPT_EXIT_CODE="
+            "{{ int .Values.maskrcnn.preempt_exit_code }}") in tmpl
+    schema = json.loads(_read(f"{chart}/values.schema.json"))
+    prop = schema["properties"]["maskrcnn"]["properties"][
+        "preempt_exit_code"]
+    assert prop["minimum"] >= 1 and prop["maximum"] <= 255
+
+
+def test_jobset_controller_version_supports_failure_policy_rules():
+    """failurePolicy.rules + RestartJobSetAndIgnoreMaxRestarts need
+    JobSet >= v0.6.0; the pinned controller manifest must not regress
+    below that while the charts render the rule."""
+    vals = yaml.safe_load(_read("charts/jobset/values.yaml"))
+    m = re.search(r"/v(\d+)\.(\d+)\.(\d+)/",
+                  vals["jobset"]["manifest_url"])
+    assert m, "jobset manifest_url must pin a version"
+    assert (int(m.group(1)), int(m.group(2))) >= (0, 6), \
+        "failurePolicy rules require JobSet v0.6.0+"
+
+
 # ---- gke-tpu-topology node label pipeline ---------------------------
 # GKE labels v5e podslice nodes with the physical chip grid
 # (v5e-32 → "4x8"); a nodeSelector carrying anything else (round 2

@@ -1,0 +1,66 @@
+#!/bin/bash
+# Run the full chaos ladder locally with a per-rung pass/fail summary.
+#
+# Every rung drives one failure mode of the resilience layer
+# (eksml_tpu/resilience/, ISSUE: graceful preemption / checkpoint
+# integrity / divergence sentinel / hang watchdog).  The subprocess
+# rungs launch real `python -m eksml_tpu.train` processes and are
+# marked slow (excluded from tier-1); the unit rungs run in seconds.
+# Everything runs under JAX_PLATFORMS=cpu with the tiny-model
+# overrides, sharing ONE XLA compile via the module-scoped cache.
+#
+# Usage:  tools/chaos_matrix.sh [--fast]
+#   --fast   unit rungs only (skip the subprocess trainer rungs)
+set -u
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+# name|pytest target — order is the ladder: cheap mechanisms first,
+# then the full subprocess failure modes
+RUNGS=(
+  "unit-watchdog|tests/test_resilience.py -k watchdog"
+  "unit-sentinel|tests/test_resilience.py -k sentinel"
+  "unit-ckpt-integrity|tests/test_resilience.py -k 'manifest or corrupt or truncated or digest or fatal or all_steps'"
+  "unit-preemption|tests/test_resilience.py -k preemption"
+  "unit-init-retry|tests/test_resilience.py tests/test_distributed.py -k 'retry or retries or exhaustion'"
+  "proc-sigkill-resume|tests/test_fault_tolerance.py::test_sigkill_then_resume"
+  "proc-sigterm-graceful|tests/test_fault_tolerance.py::test_sigterm_graceful_preempt_then_resume"
+  "proc-corrupt-latest|tests/test_fault_tolerance.py::test_corrupt_latest_checkpoint_falls_back"
+  "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"
+)
+
+declare -a NAMES RESULTS TIMES
+fails=0
+for rung in "${RUNGS[@]}"; do
+  name="${rung%%|*}"
+  target="${rung#*|}"
+  if [ "$FAST" = 1 ] && [[ "$name" == proc-* ]]; then
+    NAMES+=("$name"); RESULTS+=("SKIP"); TIMES+=("-")
+    continue
+  fi
+  echo "=== rung: $name ==="
+  t0=$(date +%s)
+  # eval keeps the single-quoted -k expressions intact
+  if eval "JAX_PLATFORMS=cpu python -m pytest $target -q \
+      -p no:cacheprovider -p no:randomly"; then
+    RESULTS+=("PASS")
+  else
+    RESULTS+=("FAIL"); fails=$((fails + 1))
+  fi
+  NAMES+=("$name"); TIMES+=("$(( $(date +%s) - t0 ))s")
+done
+
+echo
+echo "==================== chaos matrix ===================="
+printf '%-24s %-6s %s\n' "rung" "result" "time"
+for i in "${!NAMES[@]}"; do
+  printf '%-24s %-6s %s\n' "${NAMES[$i]}" "${RESULTS[$i]}" "${TIMES[$i]}"
+done
+echo "======================================================"
+if [ "$fails" -gt 0 ]; then
+  echo "LADDER FAILED: $fails rung(s) red"
+  exit 1
+fi
+echo "ladder green"
