@@ -646,9 +646,16 @@ def run(args, diag: dict) -> None:
     # make roi=auto self-describing: which backend did the per-dtype
     # probes actually choose?  (round 5: a compile-environment reject
     # silently measured the XLA fallback across a whole ladder, and
-    # only the 2x throughput gap gave it away)
-    from eksml_tpu.ops.pallas.roi_align_kernel import probe_outcomes
-    diag["roi_probe_outcomes"] = probe_outcomes()
+    # only the 2x throughput gap gave it away).  Guarded like the
+    # failure path: a pallas import error must not destroy an
+    # already-measured result
+    try:
+        from eksml_tpu.ops.pallas.roi_align_kernel import probe_outcomes
+        diag["roi_probe_outcomes"] = probe_outcomes()
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        # keep the result self-describing: "probe module broken" must
+        # stay distinguishable from "field never collected"
+        diag["roi_probe_outcomes"] = {"error": repr(e)}
     if flops_per_step:
         peak = PEAK_FLOPS.get(dev_kind, DEFAULT_PEAK)
         mfu = flops_per_step / (dt / args.steps) / (peak * n_dev)

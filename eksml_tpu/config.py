@@ -137,6 +137,45 @@ config = AttrDict()
 _C = config  # shorthand used below, TensorPack-style
 
 
+# Data-ingest robustness knobs (eksml_tpu/data/robust.py) — ONE source
+# of truth: _define_defaults installs these under RESILIENCE.DATA, and
+# the loader's fallback for pre-robustness config trees imports the
+# same dict.
+#
+# - IO_*: transient I/O errors (EIO/ESTALE/timeout — shared-filesystem
+#   blips) retry with bounded exponential backoff; decode errors and
+#   missing files are permanent and quarantine immediately.
+# - MAX_QUARANTINE_FRAC: circuit breaker — abort (naming the
+#   quarantine ledger) once MORE than this fraction of distinct
+#   records is quarantined; a vanished mount must fail loudly, not
+#   train on substitutes.
+# - MAX_POOL_REBUILDS: BrokenProcessPool (decode worker OOM-killed)
+#   pool rebuilds before degrading to in-thread decode.
+# - STARVATION_TIMEOUT_SEC: consumer-side q.get timeout; each expiry
+#   checks the producer thread is alive (a dead producer raises a
+#   diagnostic DataStarvationError instead of blocking forever).
+#   0 = wait forever (the legacy deadlock — only for debugging).
+# - VALIDATE: preflight dataset validation in CocoDataset — "off" |
+#   "warn" (log issues, drop bad annotations) | "strict" (raise);
+#   VALIDATE_SAMPLE sizes the file-existence probe.
+# - FAULT_INJECT_EIO_*: chaos hook — first COUNT reads of any image
+#   path containing the substring raise EIO (then succeed); the
+#   injected-transient rung of the chaos ladder.  "" = off.
+RESILIENCE_DATA_DEFAULTS = dict(
+    IO_RETRIES=3,              # extra attempts, transient errors only
+    IO_BACKOFF_SEC=0.5,
+    IO_BACKOFF_FACTOR=2.0,
+    IO_MAX_BACKOFF_SEC=10.0,
+    MAX_QUARANTINE_FRAC=0.05,
+    MAX_POOL_REBUILDS=1,
+    STARVATION_TIMEOUT_SEC=120.0,
+    VALIDATE="warn",
+    VALIDATE_SAMPLE=64,
+    FAULT_INJECT_EIO_PATH="",
+    FAULT_INJECT_EIO_COUNT=1,
+)
+
+
 def _define_defaults() -> None:
     # ---- mode flags (reference templates/maskrcnn.yaml:61-62) -------
     _C.MODE_MASK = True
@@ -324,13 +363,19 @@ def _define_defaults() -> None:
     _C.RESILIENCE.WATCHDOG_COMPILE_FACTOR = 20.0
     # bounded retry/backoff around jax.distributed.initialize — JobSet
     # pods start in arbitrary order and the coordinator may not be
-    # listening yet
+    # listening yet.  NOTE: counts TOTAL connection attempts (1 = no
+    # retry), unlike RESILIENCE.DATA.IO_RETRIES which counts EXTRA
+    # attempts after the first; both are pinned by tests
     _C.RESILIENCE.INIT_RETRIES = 5
     _C.RESILIENCE.INIT_BACKOFF_SEC = 2.0
     # chaos-ladder hook (tests/test_fault_tolerance.py): at this step,
     # multiply the params by NaN once — a faithful stand-in for real
     # divergence (every later loss is non-finite until rollback). 0=off.
     _C.RESILIENCE.FAULT_INJECT_NAN_STEP = 0
+
+    # ---- data-ingest robustness (eksml_tpu/data/robust.py) ----------
+    for k, v in RESILIENCE_DATA_DEFAULTS.items():
+        setattr(_C.RESILIENCE.DATA, k, v)
 
     _C.freeze()
 
@@ -348,6 +393,8 @@ def finalize_configs(is_training: bool) -> AttrDict:
 
     assert _C.BACKBONE.NORM in ("FreezeBN", "GN"), _C.BACKBONE.NORM
     assert _C.TRAIN.PRECISION in ("float32", "bfloat16"), _C.TRAIN.PRECISION
+    assert _C.RESILIENCE.DATA.VALIDATE in ("off", "warn", "strict"), (
+        _C.RESILIENCE.DATA.VALIDATE)
     assert len(_C.FPN.ANCHOR_STRIDES) == len(_C.RPN.ANCHOR_SIZES)
     assert _C.PREPROC.MAX_SIZE % max(_C.FPN.ANCHOR_STRIDES) == 0, (
         "padded image size must be divisible by the coarsest FPN stride")

@@ -18,3 +18,6 @@ from eksml_tpu.data.loader import (  # noqa: F401
     DetectionLoader, SyntheticDataset, make_synthetic_batch)
 from eksml_tpu.data.masks import (  # noqa: F401
     polygons_to_bbox_mask, rle_decode, rle_encode)
+from eksml_tpu.data.robust import (  # noqa: F401
+    DataStarvationError, LoaderHealth, PermanentDataError,
+    QuarantineLedger, QuarantineOverflowError, RobustImageReader)

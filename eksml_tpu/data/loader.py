@@ -17,13 +17,40 @@ output shapes are compile-time constants — the property XLA requires
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
+from concurrent.futures.process import BrokenProcessPool
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from eksml_tpu.data.masks import polygons_to_bbox_mask, rle_decode
+from eksml_tpu.data.robust import (DataStarvationError, LoaderHealth,
+                                   PermanentDataError, QuarantineLedger,
+                                   QuarantineOverflowError,
+                                   RobustImageReader, ledger_path_for)
+
+log = logging.getLogger(__name__)
+
+
+def _data_knobs(cfg) -> Dict:
+    """RESILIENCE.DATA values with fallbacks for callers that hand the
+    loader a config tree predating the robustness knobs — defaults are
+    the canonical ``RESILIENCE_DATA_DEFAULTS`` (one source of truth)."""
+    from eksml_tpu.config import RESILIENCE_DATA_DEFAULTS
+
+    out = dict(RESILIENCE_DATA_DEFAULTS)
+    node = getattr(getattr(cfg, "RESILIENCE", None), "DATA", None)
+    if node is not None:
+        for k in out:
+            v = getattr(node, k, None)
+            # hasattr guard: an unfrozen AttrDict materializes missing
+            # keys as empty nodes instead of raising
+            if v is not None and not hasattr(v, "to_dict"):
+                out[k] = v
+    return out
 
 
 def quantize_uint8(image_f: np.ndarray) -> np.ndarray:
@@ -159,7 +186,8 @@ class DetectionLoader:
                  host_id: int = 0, seed: int = 0,
                  with_masks: bool = True, prefetch: int = 4,
                  gt_mask_size: int = 56,
-                 num_workers: Optional[int] = None):
+                 num_workers: Optional[int] = None,
+                 ledger_dir: Optional[str] = None):
         assert len(records) > 0, "empty dataset"
         self.records = records[host_id::num_hosts]
         if not self.records:  # more hosts than records (tiny smoke runs)
@@ -185,6 +213,40 @@ class DetectionLoader:
         self._order = np.arange(len(self.records))
         self._pos = 0
         self._init_buckets(records, cfg, seed)
+        self._init_robustness(cfg, host_id, ledger_dir)
+
+    def _init_robustness(self, cfg, host_id: int,
+                         ledger_dir: Optional[str]) -> None:
+        """Fault-tolerant ingest (eksml_tpu/data/robust.py, knobs under
+        RESILIENCE.DATA): transient-I/O retry, per-record quarantine
+        with deterministic substitution, decode-pool self-healing, and
+        the health surface the hang watchdog reports from."""
+        knobs = _data_knobs(cfg)
+        self._reader = RobustImageReader(
+            io_retries=int(knobs["IO_RETRIES"]),
+            backoff_sec=float(knobs["IO_BACKOFF_SEC"]),
+            backoff_factor=float(knobs["IO_BACKOFF_FACTOR"]),
+            max_backoff_sec=float(knobs["IO_MAX_BACKOFF_SEC"]),
+            inject_eio_path=str(knobs["FAULT_INJECT_EIO_PATH"] or ""),
+            inject_eio_count=int(knobs["FAULT_INJECT_EIO_COUNT"]))
+        self._ledger = QuarantineLedger(
+            total_records=len(self.records),
+            max_frac=float(knobs["MAX_QUARANTINE_FRAC"]),
+            path=ledger_path_for(ledger_dir, host_id), host_id=host_id)
+        self.health = LoaderHealth(ledger=self._ledger,
+                                   reader=self._reader)
+        self._starvation_timeout = float(knobs["STARVATION_TIMEOUT_SEC"])
+        self._pool_rebuilds_left = int(knobs["MAX_POOL_REBUILDS"])
+        self._pool_lock = threading.Lock()
+        self._pool_break_pending = False
+        self._pool_degraded = False  # sticky: survives batches() calls
+        self._pool_decode_failures = 0
+        self._proc_pool = None
+        # dedicated substitution cursors (per bucket, -1 = general):
+        # substitution consumes NO RNG, so the cross-host bucket/draw
+        # schedule is untouched by a quarantine on one host
+        self._sub_lock = threading.Lock()
+        self._sub_pos: Dict[int, int] = {}
 
     # -- aspect-ratio buckets ------------------------------------------
 
@@ -216,6 +278,10 @@ class DetectionLoader:
             key=lambda b: b[0] * b[1])
         short_max = max(cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE)
         max_size = cfg.PREPROC.MAX_SIZE
+        # kept for quarantine substitution: a failed record's bucket is
+        # recomputed with the same draw-independent assignment
+        self._bucket_short_max = short_max
+        self._bucket_max_size = max_size
 
         def bucket_of(rec):
             return assign_bucket(rec["height"], rec["width"], short_max,
@@ -246,18 +312,156 @@ class DetectionLoader:
         do_flip = self.is_training and bool(self.rng.rand() < 0.5)
         return short, do_flip
 
+    # -- fault-tolerant image resolution ------------------------------
+
+    def _resolve_image(self, rec: Dict, image) -> np.ndarray:
+        """Future/inline image → decoded array, with fault handling.
+
+        Any worker-side failure (process-pool decode) is re-read
+        inline so the robust reader can classify it — including a
+        BrokenProcessPool, which poisons every pending future and is
+        evidence about the POOL (worker OOM-killed), not about any
+        record's bytes: the pool is flagged for a rebuild and each
+        affected record is quarantined only if its inline re-read
+        fails with real evidence.  Raises PermanentDataError when the
+        record's bytes cannot be produced.
+        """
+        if image is not None and hasattr(image, "result"):
+            try:
+                image = image.result()  # process-pool decode future
+            except BrokenProcessPool:
+                self._note_pool_break()
+                image = None  # verify the bytes inline
+            except Exception as e:  # noqa: BLE001 — reclassified inline
+                self._note_pool_decode_failure(e)
+                image = None  # re-read inline to classify/retry
+        if image is not None:
+            return image
+        if rec.get("_image") is not None:
+            return rec["_image"]
+        t0 = time.monotonic()
+        image = self._reader.read(rec["path"])  # raises PermanentDataError
+        self.health.note_decode((time.monotonic() - t0) * 1000)
+        return image
+
+    def _materialize(self, rec: Dict, image) -> Tuple[Dict, np.ndarray]:
+        """(record, decoded image), substituting quarantined/failed
+        records.  Termination: every failure quarantines a distinct
+        record, and the ledger's circuit breaker (or an exhausted
+        substitution cycle) raises before the loop can spin."""
+        while True:
+            if self._ledger.is_quarantined(rec.get("image_id")):
+                # repeat draw of a known-bad record: substitute
+                # silently — the ledger is a census of distinct bad
+                # records, not of draws
+                rec, image = self._substitute_for(rec), None
+                continue
+            try:
+                return rec, self._resolve_image(rec, image)
+            except PermanentDataError as e:
+                self._ledger.quarantine(
+                    rec.get("image_id"), rec, e.kind, repr(e.cause),
+                    e.attempts)  # raises QuarantineOverflowError at the breaker
+                rec, image = self._substitute_for(rec), None
+
+    def _substitute_for(self, failed_rec: Dict) -> Dict:
+        """Deterministic replacement from the failed record's bucket
+        cycle (general cycle in non-bucket mode or when the shard's
+        bucket is empty).  Walks dedicated cursors and consumes no
+        RNG: batch shapes and the cross-host bucket/draw schedule are
+        unchanged by a quarantine on one host."""
+        cycles: List[Tuple[int, np.ndarray]] = []
+        if self.bucket_mode:
+            b = assign_bucket(
+                failed_rec["height"], failed_rec["width"],
+                self._bucket_short_max, self._bucket_max_size,
+                self.buckets)
+            if len(self._bucket_orders[b]):
+                cycles.append((b, self._bucket_orders[b]))
+        cycles.append((-1, self._order))
+        with self._sub_lock:
+            for key, order in cycles:
+                for _ in range(len(order)):
+                    pos = self._sub_pos.get(key, 0)
+                    self._sub_pos[key] = (pos + 1) % len(order)
+                    cand = self.records[int(order[pos])]
+                    if cand is failed_rec:
+                        continue
+                    if self._ledger.is_quarantined(cand.get("image_id")):
+                        continue
+                    return cand
+        raise QuarantineOverflowError(
+            f"no healthy record left on this host to substitute for "
+            f"image_id={failed_rec.get('image_id')}; quarantine "
+            f"ledger: {self._ledger.path or '<in-memory>'}")
+
+    # -- decode process-pool self-healing -----------------------------
+
+    def _make_proc_pool(self):
+        from concurrent.futures import ProcessPoolExecutor
+        from multiprocessing import get_context
+
+        return ProcessPoolExecutor(max_workers=self.worker_processes,
+                                   mp_context=get_context("spawn"))
+
+    def _note_pool_decode_failure(self, exc: BaseException) -> None:
+        """A pooled decode failed and will be re-read inline.  One
+        loud line for the first occurrence: a SYSTEMATICALLY failing
+        pool (spawn workers missing a codec the parent has) would
+        otherwise silently halve decode throughput for the whole run."""
+        with self._pool_lock:
+            self._pool_decode_failures += 1
+            n = self._pool_decode_failures
+        if n == 1:
+            log.warning("decode worker raised %r for a pooled read — "
+                        "re-reading inline (further worker failures "
+                        "logged at DEBUG; a failure on EVERY read "
+                        "means the pool is doing no useful work)", exc)
+        else:
+            log.debug("pooled decode failure #%d: %r", n, exc)
+
+    def _note_pool_break(self) -> None:
+        """Record a BrokenProcessPool incident (idempotent; healed at
+        the next batch boundary)."""
+        with self._pool_lock:
+            first = not self._pool_break_pending
+            self._pool_break_pending = True
+        if first:
+            log.warning(
+                "decode process pool broke (worker died — OOM kill?); "
+                "re-reading the affected batch inline and scheduling "
+                "a pool rebuild")
+
+    def _heal_proc_pool(self) -> None:
+        """Rebuild the broken decode pool (bounded by
+        RESILIENCE.DATA.MAX_POOL_REBUILDS), then degrade to in-thread
+        decode — never abort the job over a dead decode worker."""
+        with self._pool_lock:
+            if not self._pool_break_pending:
+                return
+            self._pool_break_pending = False
+        old, self._proc_pool = self._proc_pool, None
+        if old is not None:
+            old.shutdown(wait=False, cancel_futures=True)
+        if self._pool_rebuilds_left > 0:
+            self._pool_rebuilds_left -= 1
+            self._proc_pool = self._make_proc_pool()
+            log.warning("decode process pool rebuilt (%d rebuild(s) "
+                        "left)", self._pool_rebuilds_left)
+        else:
+            self._pool_degraded = True  # no resurrection on re-iterate
+            log.warning(
+                "decode pool rebuild budget exhausted (RESILIENCE."
+                "DATA.MAX_POOL_REBUILDS) — degrading to in-thread "
+                "decode")
+
+    # -- single example (continued) -----------------------------------
+
     def _load_example(self, rec: Dict, short: int, do_flip: bool,
                       pad_hw: Optional[Tuple[int, int]] = None,
                       image: Optional[np.ndarray] = None
                       ) -> Dict[str, np.ndarray]:
-        if image is not None and hasattr(image, "result"):
-            image = image.result()  # process-pool decode future
-        if image is None:
-            if rec.get("_image") is not None:
-                image = rec["_image"]
-            else:
-                from eksml_tpu.data.coco import load_image
-                image = load_image(rec["path"])
+        rec, image = self._materialize(rec, image)
         boxes = rec["boxes"].copy()
         classes = rec["classes"]
         # crowd boxes are kept: the model treats them as ignore regions
@@ -413,24 +617,24 @@ class DetectionLoader:
                                       thread_name_prefix="decode")
         # DATA.WORKER_PROCESSES: JPEG decode sidesteps the GIL in
         # worker processes (spawn: no forked JAX/TPU client state);
-        # everything downstream of decode stays on the thread pipeline
-        proc_pool = None
-        if (self.worker_processes > 0
+        # everything downstream of decode stays on the thread pipeline.
+        # Held on self so a BrokenProcessPool can heal it mid-run; once
+        # the rebuild budget is spent the degradation sticks — a later
+        # batches() call must not silently resurrect the pool.
+        if (self.worker_processes > 0 and self._proc_pool is None
+                and not self._pool_degraded
                 and any(r.get("_image") is None for r in self.records)):
-            from concurrent.futures import ProcessPoolExecutor
-            from multiprocessing import get_context
+            self._proc_pool = self._make_proc_pool()
 
-            from eksml_tpu.data.coco import load_image
-
-            proc_pool = ProcessPoolExecutor(
-                max_workers=self.worker_processes,
-                mp_context=get_context("spawn"))
+        from eksml_tpu.data.coco import load_image
 
         def producer():
             produced = 0
             try:
                 while not stop.is_set() and (num_steps is None
                                              or produced < num_steps):
+                    t_build = time.monotonic()
+                    self._heal_proc_pool()  # no-op unless a break is pending
                     pad_hw, idx = self._next_bucket_batch()
                     recs = [self.records[i] for i in idx]
                     draws = [self._draw() for _ in idx]
@@ -439,11 +643,28 @@ class DetectionLoader:
                     # — decode and resize/augment overlap instead of
                     # running as serial per-batch stages
                     images = [None] * len(recs)
-                    if proc_pool is not None:
-                        for i, r in enumerate(recs):
-                            if r.get("_image") is None:
-                                images[i] = proc_pool.submit(
-                                    load_image, r["path"])
+                    if self._proc_pool is not None:
+                        try:
+                            for i, r in enumerate(recs):
+                                # known-bad records substitute in
+                                # _materialize (decoding them again in
+                                # a subprocess is pure wasted work);
+                                # injection-targeted paths stay inline
+                                # so the chaos hook fires even with a
+                                # process pool
+                                if (r.get("_image") is None
+                                        and not self._ledger
+                                        .is_quarantined(
+                                            r.get("image_id"))
+                                        and not self._reader
+                                        .matches_injection(r["path"])):
+                                    images[i] = self._proc_pool.submit(
+                                        load_image, r["path"])
+                        except BrokenProcessPool:
+                            # pool died between batches: flag for the
+                            # next heal; unsubmitted records decode
+                            # inline this batch
+                            self._note_pool_break()
                     if pool is not None:
                         exs = list(pool.map(
                             self._load_example, recs,
@@ -455,6 +676,8 @@ class DetectionLoader:
                                in zip(recs, draws, images)]
                     batch = {k: np.stack([e[k] for e in exs])
                              for k in exs[0].keys()}
+                    self.health.record_batch(
+                        (time.monotonic() - t_build) * 1000)
                     if not put_or_stop(batch):
                         return
                     produced += 1
@@ -464,10 +687,43 @@ class DetectionLoader:
                 put_or_stop(None)
 
         t = threading.Thread(target=producer, daemon=True)
+        self.health.queue_depth = q.qsize
+        self.health.producer_alive = t.is_alive
         t.start()
+        # RESILIENCE.DATA.STARVATION_TIMEOUT_SEC: each expiry checks
+        # the producer is still alive — a producer that died without
+        # delivering its sentinel (hard kill, unraisable teardown)
+        # raises a diagnostic instead of blocking this q.get forever
+        timeout = (self._starvation_timeout
+                   if self._starvation_timeout > 0 else None)
         try:
             while True:
-                batch = q.get()
+                try:
+                    batch = q.get(timeout=timeout)
+                except queue.Empty:
+                    if t.is_alive():
+                        self.health.note_starvation_wait()
+                        log.warning(
+                            "input starvation: no batch for %.0fs "
+                            "(producer alive, queue empty) — waiting; "
+                            "pipeline: %s", self._starvation_timeout,
+                            self.health.scalars())
+                        continue
+                    # producer is dead — but it may have finished
+                    # normally in the race window between the timeout
+                    # and the aliveness check: drain before declaring
+                    # starvation
+                    try:
+                        batch = q.get_nowait()
+                    except queue.Empty:
+                        if error:
+                            raise error[0]
+                        raise DataStarvationError(
+                            "data producer thread is dead with nothing "
+                            "queued and no end-of-stream sentinel — "
+                            "the consumer would have blocked forever.\n"
+                            "data pipeline state:\n"
+                            + self.health.report()) from None
                 if batch is None:
                     if error:
                         raise error[0]
@@ -478,8 +734,19 @@ class DetectionLoader:
             t.join(timeout=5.0)
             if pool is not None:
                 pool.shutdown(wait=False)
-            if proc_pool is not None:
-                proc_pool.shutdown(wait=False, cancel_futures=True)
+            if self._proc_pool is not None:
+                self._proc_pool.shutdown(wait=False, cancel_futures=True)
+                self._proc_pool = None
+            # the incident died with that pool: a stale flag would make
+            # the next batches() call tear down its fresh pool and
+            # silently burn the rebuild budget
+            with self._pool_lock:
+                self._pool_break_pending = False
+            # drop the dead pipeline's closures: keeping q.qsize /
+            # t.is_alive bound would pin up to `prefetch` full batches
+            # in memory and feed the watchdog stale state
+            self.health.queue_depth = lambda: 0
+            self.health.producer_alive = lambda: False
 
 
 def _crop_resize_binary(mask: np.ndarray, box, out_size: int) -> np.ndarray:

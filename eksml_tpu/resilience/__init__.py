@@ -24,6 +24,12 @@ ones, one module per pillar:
 - :mod:`retry` — bounded retry/backoff used around
   ``jax.distributed.initialize`` (pods start in arbitrary order).
 
+The *ingest* half of the fault story — transient-I/O retry, per-record
+quarantine with deterministic substitution, decode-pool self-healing,
+and the starvation heartbeat the watchdog reports from — lives with
+the data layer in :mod:`eksml_tpu.data.robust` (knobs under
+``config.RESILIENCE.DATA``).
+
 Knobs live in ``config.RESILIENCE``; the chaos ladder in
 tests/test_fault_tolerance.py and tools/chaos_matrix.sh exercises each
 pillar against a real subprocess trainer.

@@ -165,15 +165,43 @@ def verify_step(root: str, step: int,
                     extra[0])
     for rel, entry in expected.items():
         path = os.path.join(step_dir, rel)
-        size = os.path.getsize(path)
-        if size != entry["size"]:
-            return False, (f"step {step}: {rel} is {size} bytes, "
-                           f"manifest says {entry['size']} (truncated "
-                           "commit?)")
-        if check_digest and "sha256" in entry:
-            got = _sha256(path)
-            if got != entry["sha256"]:
-                return False, f"step {step}: {rel} sha256 mismatch"
+
+        # An I/O error while *verifying* is evidence about the MOUNT,
+        # not the step's bytes: retry the blip (NFS failover, ESTALE)
+        # with short backoff.  FileNotFoundError stays un-retried —
+        # a manifest-listed file being absent IS corruption evidence.
+        # Persistent failure raises (retry_call's RuntimeError): the
+        # relaunch crashes and the orchestrator retries later, which
+        # preserves the step — quarantining here would let one mount
+        # outage destroy every good checkpoint newest-first.
+        def check(path=path, entry=entry, rel=rel):
+            size = os.path.getsize(path)
+            if size != entry["size"]:
+                return False, (f"step {step}: {rel} is {size} bytes, "
+                               f"manifest says {entry['size']} "
+                               "(truncated commit?)")
+            if check_digest and "sha256" in entry:
+                if _sha256(path) != entry["sha256"]:
+                    return False, f"step {step}: {rel} sha256 mismatch"
+            return True, ""
+
+        def check_absent_is_evidence():
+            # FileNotFoundError is corruption evidence (walk back),
+            # never a retryable blip — keep it out of the OSError retry
+            try:
+                return check()
+            except FileNotFoundError:
+                return False, (f"step {step}: {rel} vanished during "
+                               "verification")
+
+        from eksml_tpu.resilience.retry import retry_call
+
+        ok, why = retry_call(
+            check_absent_is_evidence, attempts=3, backoff_sec=0.5,
+            retry_on=(OSError,),
+            describe=f"verifying checkpoint step {step} file {rel}")
+        if not ok:
+            return False, why
     return True, f"step {step}: verified against manifest"
 
 

@@ -54,6 +54,16 @@ class HangWatchdog:
         self._compile_headroom = True
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._providers: list = []  # (name, fn) report sections
+
+    def add_report_provider(self, name: str, fn: Callable[[], str]
+                            ) -> None:
+        """Attach a diagnostic section to every hang report — e.g. the
+        data loader's health surface (queue depth, stage timing,
+        quarantine census), so input starvation reads as a diagnosis
+        instead of a bare stack dump.  ``fn`` is called on the
+        watchdog thread at dump time; failures are contained."""
+        self._providers.append((name, fn))
 
     # -- lifecycle ----------------------------------------------------
 
@@ -157,6 +167,13 @@ class HangWatchdog:
             self._host_line(),
             "",
         ]
+        for name, fn in self._providers:
+            lines.append(f"--- {name} ---")
+            try:
+                lines.extend(str(fn()).splitlines())
+            except Exception as e:  # noqa: BLE001 — report must land
+                lines.append(f"<report provider failed: {e!r}>")
+            lines.append("")
         frames = sys._current_frames()
         threads = {t.ident: t for t in threading.enumerate()}
         for ident, frame in frames.items():

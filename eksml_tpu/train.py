@@ -298,7 +298,7 @@ class Trainer:
     def fit(self, batches: Iterator[Dict[str, np.ndarray]],
             total_steps: int, start_step: int = 0,
             state: Optional[TrainState] = None,
-            profile_steps: int = 0) -> TrainState:
+            profile_steps: int = 0, data_health=None) -> TrainState:
         """``profile_steps``: capture a ``jax.profiler`` trace of that
         many post-compile steps into ``<logdir>/profile`` (the
         one-command perf-visibility path, SURVEY.md §5.1 — the
@@ -309,7 +309,15 @@ class Trainer:
         step boundary and exits with the resumable code; non-finite
         losses roll back to the last good checkpoint and never reach
         ``ckpt.save``; a heartbeat watchdog dumps all-thread stacks
-        when a step exceeds its deadline."""
+        when a step exceeds its deadline.
+
+        ``data_health``: the loader's ``LoaderHealth`` surface
+        (data/robust.py).  When given, its scalars (queue depth,
+        quarantine census, batch-build timing) ride the metric stream
+        at every log step, and its report joins the watchdog's hang
+        dump — so input starvation (TPU idle, queue empty past the
+        deadline) reads as a stalled-phase diagnosis, not a generic
+        hang."""
         cfg = self.cfg
         res = cfg.RESILIENCE
         step_fn = None
@@ -331,6 +339,11 @@ class Trainer:
             watchdog = HangWatchdog(
                 res.WATCHDOG_TIMEOUT_SEC, report_dir=self.logdir,
                 first_beat_factor=res.WATCHDOG_COMPILE_FACTOR).start()
+            if data_health is not None:
+                # loader heartbeat → hang report: queue depth, stage
+                # timing, quarantine stats alongside the thread stacks
+                watchdog.add_report_provider("data pipeline",
+                                             data_health.report)
         sentinel = DivergenceSentinel(patience=res.NAN_PATIENCE,
                                       max_rollbacks=res.MAX_ROLLBACKS)
         nan_injected = False
@@ -403,7 +416,8 @@ class Trainer:
                         step, float(np.asarray(metrics["total_loss"])))
                     if action == ROLLBACK:
                         state, step = self._rollback(sentinel, state,
-                                                     step)
+                                                     step,
+                                                     watchdog=watchdog)
                         steps_since_log = 0
                         t_last = time.time()
                         continue
@@ -411,6 +425,11 @@ class Trainer:
                 if log_step:
                     metrics = jax.tree.map(lambda x: float(np.asarray(x)),
                                            metrics)
+                    if data_health is not None:
+                        metrics.update(
+                            {f"data/{k}": float(v) for k, v
+                             in data_health.scalars().items()
+                             if isinstance(v, (int, float))})
                     dt = time.time() - t_last
                     t_last = time.time()
                     # normalize by the steps actually covered since the
@@ -508,12 +527,17 @@ class Trainer:
         return state
 
     def _rollback(self, sentinel: DivergenceSentinel, state: TrainState,
-                  step: int) -> Tuple[TrainState, int]:
+                  step: int, watchdog=None) -> Tuple[TrainState, int]:
         """Divergence recovery: restore the newest verified checkpoint
         and continue from there.  The data iterator is NOT rewound, so
         the re-run consumes fresh batches — the window that fed the
         divergence is skipped.  Raises DivergenceError when there is
         nothing to restore or the rollback budget is spent."""
+        if watchdog:
+            # a multi-GB restore from the shared fs legitimately
+            # exceeds a step-sized deadline — this is recovery, not a
+            # hang
+            watchdog.beat("rollback_restore", step)
         restored = self.ckpt.restore_with_fallback(state)
         if restored is None:
             raise sentinel.no_checkpoint_to_restore(step)
@@ -638,35 +662,52 @@ def main(argv=None):
         eval_fn = make_eval_fn(cfg)
 
     trainer = Trainer(cfg, cfg.TRAIN.LOGDIR, eval_fn=eval_fn)
-    # batch sizing follows the mesh, not local_devices(): a subset mesh
-    # (single-chip smoke on a multi-device host) must not inflate the
-    # per-host batch
-    local_chips = sum(d.process_index == jax.process_index()
-                      for d in trainer.mesh.devices.flat)
-    per_host_batch = cfg.TRAIN.BATCH_SIZE_PER_CHIP * max(1, local_chips)
-    if cfg.DATA.SYNTHETIC:
-        records = SyntheticDataset(
-            num_images=64, height=cfg.PREPROC.MAX_SIZE,
-            width=cfg.PREPROC.MAX_SIZE,
-            num_classes=cfg.DATA.NUM_CLASSES).records()
-    else:
-        from eksml_tpu.data import CocoDataset
-
-        records = []
-        for split in cfg.DATA.TRAIN:
-            records += CocoDataset(cfg.DATA.BASEDIR, split).records()
-
-    loader = DetectionLoader(
-        records, cfg, per_host_batch, is_training=True,
-        num_hosts=jax.process_count(), host_id=jax.process_index(),
-        seed=cfg.TRAIN.SEED, with_masks=cfg.MODE_MASK)
-
-    total_steps = (args.total_steps if args.total_steps is not None
-                   else cfg.TRAIN.STEPS_PER_EPOCH * cfg.TRAIN.MAX_EPOCHS)
-
+    # everything after the Trainer exists runs under the try: dataset
+    # preflight (strict mode raises) and loader construction (a
+    # resumed over-threshold quarantine ledger raises) must still
+    # reach the finally that closes the checkpoint manager — live
+    # Orbax threads at interpreter teardown flake-crash and can garble
+    # the actionable abort message
     try:
+        # batch sizing follows the mesh, not local_devices(): a subset
+        # mesh (single-chip smoke on a multi-device host) must not
+        # inflate the per-host batch
+        local_chips = sum(d.process_index == jax.process_index()
+                          for d in trainer.mesh.devices.flat)
+        per_host_batch = cfg.TRAIN.BATCH_SIZE_PER_CHIP * max(
+            1, local_chips)
+        if cfg.DATA.SYNTHETIC:
+            records = SyntheticDataset(
+                num_images=64, height=cfg.PREPROC.MAX_SIZE,
+                width=cfg.PREPROC.MAX_SIZE,
+                num_classes=cfg.DATA.NUM_CLASSES).records()
+        else:
+            from eksml_tpu.data import CocoDataset
+
+            records = []
+            for split in cfg.DATA.TRAIN:
+                # preflight: unknown categories / degenerate fields /
+                # sampled file-existence probe, BEFORE the first step —
+                # warn-and-continue or strict-abort (RESILIENCE.DATA.*)
+                records += CocoDataset(
+                    cfg.DATA.BASEDIR, split,
+                    validate=cfg.RESILIENCE.DATA.VALIDATE,
+                    validate_sample=cfg.RESILIENCE.DATA.VALIDATE_SAMPLE,
+                ).records()
+
+        loader = DetectionLoader(
+            records, cfg, per_host_batch, is_training=True,
+            num_hosts=jax.process_count(), host_id=jax.process_index(),
+            seed=cfg.TRAIN.SEED, with_masks=cfg.MODE_MASK,
+            ledger_dir=cfg.TRAIN.LOGDIR)
+
+        total_steps = (args.total_steps
+                       if args.total_steps is not None
+                       else cfg.TRAIN.STEPS_PER_EPOCH
+                       * cfg.TRAIN.MAX_EPOCHS)
         trainer.fit(loader.batches(None), total_steps,
-                    profile_steps=args.profile)
+                    profile_steps=args.profile,
+                    data_health=loader.health)
     except PreemptedError as e:
         log.warning("preempted at step %d: exiting with resumable "
                     "code %d (JobSet restarts without burning a "

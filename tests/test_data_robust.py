@@ -1,0 +1,504 @@
+"""Fault-tolerant ingest invariants (eksml_tpu/data/robust.py).
+
+The contract under test (ISSUE 2): transient I/O retries with bounded
+backoff and recovers without a trace; permanent failures quarantine
+exactly once and are replaced by deterministic substitutes that leave
+batch shapes AND the cross-host bucket/draw schedule untouched; the
+MAX_QUARANTINE_FRAC circuit breaker turns systemic data loss into one
+actionable error naming the ledger; a dead producer raises a
+diagnostic instead of deadlocking the consumer.  The chaos-ladder
+halves that drive a real subprocess trainer live in
+tests/test_fault_tolerance.py.
+"""
+
+import errno
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from eksml_tpu.data import DetectionLoader
+from eksml_tpu.data.coco import CocoDataset
+from eksml_tpu.data.robust import (PERMANENT, TRANSIENT,
+                                   DataStarvationError, PermanentDataError,
+                                   QuarantineLedger,
+                                   QuarantineOverflowError,
+                                   RobustImageReader, classify_error)
+
+# ---- fixtures -------------------------------------------------------
+
+
+def _disk_records(tmp_path, n=6, sizes=None, prefix="img"):
+    """n JPEGs on disk + loader records (bypassing CocoDataset so each
+    test controls exactly what is on disk)."""
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    sizes = sizes or [(40, 50)] * n
+    os.makedirs(str(tmp_path), exist_ok=True)
+    recs = []
+    for i in range(n):
+        h, w = sizes[i % len(sizes)]
+        path = str(tmp_path / f"{prefix}_{i:03d}.jpg")
+        Image.fromarray(
+            rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+        ).save(path, quality=90)
+        recs.append({
+            "image_id": i, "path": path, "height": h, "width": w,
+            "boxes": np.asarray([[2., 2., 20., 20.]], np.float32),
+            "classes": np.asarray([1], np.int32),
+            "iscrowd": np.zeros(1, np.int32),
+            "segmentation": [None],
+        })
+    return recs
+
+
+def _small_cfg(cfg, max_quarantine_frac=0.5):
+    cfg.PREPROC.MAX_SIZE = 64
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (32, 32)
+    cfg.DATA.MAX_GT_BOXES = 4
+    cfg.DATA.NUM_WORKERS = 0
+    cfg.DATA.WORKER_PROCESSES = 0
+    cfg.RESILIENCE.DATA.IO_BACKOFF_SEC = 0.001
+    cfg.RESILIENCE.DATA.MAX_QUARANTINE_FRAC = max_quarantine_frac
+    return cfg
+
+
+def _loader(recs, cfg, **kw):
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("seed", 3)
+    kw.setdefault("num_workers", 0)
+    kw.setdefault("gt_mask_size", 8)
+    kw.setdefault("prefetch", 1)
+    return DetectionLoader(recs, cfg, **kw)
+
+
+def _truncate(path):
+    with open(path, "wb") as f:
+        f.write(b"\xff\xd8\xff\xe0 truncated jpeg")
+
+
+# ---- fault classification + bounded retry ---------------------------
+
+
+def test_classify_transient_vs_permanent():
+    assert classify_error(OSError(errno.EIO, "io")) == TRANSIENT
+    assert classify_error(OSError(errno.ESTALE, "stale nfs")) == TRANSIENT
+    assert classify_error(TimeoutError()) == TRANSIENT
+    assert classify_error(FileNotFoundError(2, "gone")) == PERMANENT
+    assert classify_error(ValueError("broken data stream")) == PERMANENT
+    assert classify_error(OSError("image file is truncated")) == PERMANENT
+
+
+def test_transient_eio_retries_then_succeeds():
+    img = np.zeros((4, 4, 3), np.uint8)
+    calls = []
+
+    def load(path):
+        calls.append(path)
+        if len(calls) < 3:
+            raise OSError(errno.EIO, "injected")
+        return img
+
+    r = RobustImageReader(io_retries=3, backoff_sec=0.001,
+                          sleep=lambda s: None, load=load)
+    assert r.read("/x.jpg") is img
+    assert len(calls) == 3
+    assert r.transient_recoveries == 1
+
+
+def test_decode_error_is_permanent_no_retry():
+    calls = []
+
+    def load(path):
+        calls.append(path)
+        raise ValueError("broken data stream")
+
+    r = RobustImageReader(io_retries=5, sleep=lambda s: None, load=load)
+    with pytest.raises(PermanentDataError) as ei:
+        r.read("/x.jpg")
+    assert ei.value.kind == "decode"
+    assert len(calls) == 1, "decode errors must not burn retries"
+
+
+def test_missing_file_is_permanent():
+    def load(path):
+        raise FileNotFoundError(errno.ENOENT, "gone", path)
+
+    r = RobustImageReader(sleep=lambda s: None, load=load)
+    with pytest.raises(PermanentDataError) as ei:
+        r.read("/x.jpg")
+    assert ei.value.kind == "missing"
+
+
+def test_transient_exhaustion_becomes_permanent_with_backoff():
+    sleeps = []
+
+    def load(path):
+        raise OSError(errno.ESTALE, "stale forever")
+
+    r = RobustImageReader(io_retries=2, backoff_sec=0.5,
+                          backoff_factor=2.0, sleep=sleeps.append,
+                          load=load)
+    with pytest.raises(PermanentDataError) as ei:
+        r.read("/x.jpg")
+    assert ei.value.kind == "io_exhausted"
+    assert ei.value.attempts == 3
+    assert sleeps == [0.5, 1.0]  # exponential, bounded
+
+
+# ---- quarantine substitution invariants -----------------------------
+
+
+def test_substituted_batches_keep_identical_shapes(fresh_config, tmp_path):
+    cfg = _small_cfg(fresh_config)
+    recs = _disk_records(tmp_path)
+    _truncate(recs[2]["path"])
+    loader = _loader(recs, cfg)
+    batches = list(loader.batches(8))
+    assert len(batches) == 8
+    for b in batches:
+        assert b["images"].shape == (2, 64, 64, 3)
+        assert b["gt_boxes"].shape == (2, 4, 4)
+    assert loader._ledger.count == 1
+    assert loader._ledger.entries[0]["image_id"] == 2
+
+
+def test_quarantine_is_per_record_not_per_draw(fresh_config, tmp_path):
+    """Repeat draws of a known-bad record substitute silently: the
+    ledger is a census of distinct bad records."""
+    cfg = _small_cfg(fresh_config)
+    recs = _disk_records(tmp_path, n=3)
+    _truncate(recs[0]["path"])
+    logdir = str(tmp_path / "log")
+    loader = _loader(recs, cfg, ledger_dir=logdir)
+    list(loader.batches(12))  # 24 draws over 3 records
+    assert loader._ledger.count == 1
+    with open(os.path.join(logdir, "quarantine-host0.jsonl")) as f:
+        lines = [json.loads(l) for l in f]
+    assert len(lines) == 1
+    assert lines[0]["kind"] == "decode"
+    assert lines[0]["path"] == recs[0]["path"]
+
+
+def test_quarantine_leaves_cross_host_schedule_unchanged(
+        fresh_config, tmp_path):
+    """The hard invariant (SURVEY.md §7 #4): substitution consumes NO
+    RNG, so a corrupt record on one host cannot skew the shared bucket
+    schedule or the per-example draws — every host keeps compiling and
+    entering the same program each step."""
+    cfg = _small_cfg(fresh_config)
+    cfg.PREPROC.BUCKETS = ((32, 64), (64, 32), (64, 64))
+    sizes = [(40, 60), (60, 40)] * 3  # landscape/portrait mix
+    clean = _disk_records(tmp_path / "clean", sizes=sizes)
+    dirty = _disk_records(tmp_path / "dirty", sizes=sizes)
+    _truncate(dirty[1]["path"])
+
+    la = _loader(clean, cfg.clone(), seed=7)
+    lb = _loader(dirty, cfg.clone(), seed=7)
+    shapes_a = [b["images"].shape for b in la.batches(10)]
+    shapes_b = [b["images"].shape for b in lb.batches(10)]
+    assert lb._ledger.count == 1
+    # identical bucket sequence (= identical compiled-program sequence)
+    assert shapes_a == shapes_b
+    # and identical RNG streams after the fact: neither the shared
+    # schedule RNG nor the per-example draw RNG advanced differently
+    np.testing.assert_array_equal(la._sched_rng.get_state()[1],
+                                  lb._sched_rng.get_state()[1])
+    np.testing.assert_array_equal(la.rng.get_state()[1],
+                                  lb.rng.get_state()[1])
+
+
+def test_substitute_comes_from_same_bucket(fresh_config, tmp_path):
+    cfg = _small_cfg(fresh_config)
+    cfg.PREPROC.BUCKETS = ((32, 64), (64, 32))
+    sizes = [(40, 60), (60, 40)] * 2  # ids 0,2 landscape; 1,3 portrait
+    recs = _disk_records(tmp_path, n=4, sizes=sizes)
+    _truncate(recs[1]["path"])  # portrait record
+    loader = _loader(recs, cfg, batch_size=1)
+    sub = loader._substitute_for(recs[1])
+    assert sub["image_id"] == 3, (
+        "substitute must walk the failed record's own bucket cycle")
+
+
+def test_circuit_breaker_trips_at_configured_fraction(
+        fresh_config, tmp_path):
+    cfg = _small_cfg(fresh_config, max_quarantine_frac=0.2)
+    recs = _disk_records(tmp_path)
+    for r in recs[:3]:
+        _truncate(r["path"])
+    logdir = str(tmp_path / "log")
+    loader = _loader(recs, cfg, ledger_dir=logdir)
+    with pytest.raises(QuarantineOverflowError) as ei:
+        list(loader.batches(20))
+    msg = str(ei.value)
+    # actionable: names the knob and the ledger file
+    assert "MAX_QUARANTINE_FRAC" in msg
+    assert os.path.join(logdir, "quarantine-host0.jsonl") in msg
+    # 1/6 = 0.17 ≤ 0.2 survives; the second quarantine (0.33) trips
+    assert loader._ledger.count == 2
+
+
+def test_ledger_reload_on_resume_keeps_census_deduplicated(tmp_path):
+    """A preemption-resume with the same logdir must not re-append
+    known-bad records (the ledger is a census), and must substitute
+    them immediately without re-paying the retry cost."""
+    path = str(tmp_path / "quarantine-host0.jsonl")
+    led = QuarantineLedger(total_records=10, max_frac=0.5, path=path)
+    led.quarantine(3, {"image_id": 3, "path": "/x.jpg"}, "decode",
+                   "bad", 1)
+    # the relaunch: same logdir, fresh process
+    led2 = QuarantineLedger(total_records=10, max_frac=0.5, path=path)
+    assert led2.count == 1 and led2.is_quarantined(3)
+    led2.quarantine(3, {"image_id": 3, "path": "/x.jpg"}, "decode",
+                    "bad", 1)  # re-discovery must not duplicate
+    with open(path) as f:
+        assert len(f.readlines()) == 1
+
+
+def test_ledger_reload_above_breaker_refuses_to_resume(tmp_path):
+    """The breaker must hold across relaunches: a restart whose
+    reloaded ledger is already above MAX_QUARANTINE_FRAC would
+    otherwise train on substitutes with no NEW quarantine to trip."""
+    path = str(tmp_path / "quarantine-host0.jsonl")
+    led = QuarantineLedger(total_records=10, max_frac=0.9, path=path)
+    for i in range(3):
+        led.quarantine(i, {"image_id": i}, "decode", "bad", 1)
+    with pytest.raises(QuarantineOverflowError, match="resumed"):
+        QuarantineLedger(total_records=10, max_frac=0.2, path=path)
+
+
+def test_ledger_breaker_unit():
+    led = QuarantineLedger(total_records=10, max_frac=0.15)
+    led.quarantine(1, {"image_id": 1}, "decode", "bad", 1)
+    led.quarantine(1, {"image_id": 1}, "decode", "bad", 1)  # dedupe
+    assert led.count == 1 and led.fraction == 0.1
+    with pytest.raises(QuarantineOverflowError):
+        led.quarantine(2, {"image_id": 2}, "missing", "gone", 1)
+
+
+def test_injected_eio_recovers_without_ledger_entry(
+        fresh_config, tmp_path):
+    cfg = _small_cfg(fresh_config)
+    cfg.RESILIENCE.DATA.FAULT_INJECT_EIO_PATH = "img_001"
+    cfg.RESILIENCE.DATA.FAULT_INJECT_EIO_COUNT = 1
+    recs = _disk_records(tmp_path)
+    loader = _loader(recs, cfg)
+    batches = list(loader.batches(8))  # 16 draws: img_001 drawn
+    assert len(batches) == 8
+    assert loader._ledger.count == 0, (
+        "a recovered transient must leave no quarantine trace")
+    assert loader._reader.transient_recoveries == 1
+
+
+def test_injection_fires_even_with_process_pool(fresh_config, tmp_path,
+                                                monkeypatch):
+    """The chaos EIO hook lives in the parent's reader; spawned decode
+    workers cannot see it.  The producer must keep injection-targeted
+    paths OUT of the pool (until the injection budget is spent) so the
+    eio-recover rung exercises the real retry path under
+    WORKER_PROCESSES>0 instead of silently passing."""
+    cfg = _small_cfg(fresh_config)
+    cfg.DATA.WORKER_PROCESSES = 2
+    cfg.RESILIENCE.DATA.FAULT_INJECT_EIO_PATH = "img_001"
+    cfg.RESILIENCE.DATA.FAULT_INJECT_EIO_COUNT = 1
+    cfg.RESILIENCE.DATA.IO_BACKOFF_SEC = 0.001
+    recs = _disk_records(tmp_path)
+    loader = _loader(recs, cfg)
+
+    from eksml_tpu.data.coco import load_image
+
+    submitted = []
+
+    class FakeFuture:
+        def __init__(self, path):
+            self.path = path
+
+        def result(self):
+            return load_image(self.path)
+
+    class FakePool:
+        def submit(self, fn, path):
+            submitted.append(path)
+            return FakeFuture(path)
+
+        def shutdown(self, wait=False, cancel_futures=False):
+            pass
+
+    monkeypatch.setattr(loader, "_make_proc_pool", FakePool)
+    list(loader.batches(8))  # 16 draws: img_001 drawn repeatedly
+    assert loader._reader.transient_recoveries == 1, (
+        "the injected transient must flow through the robust reader")
+    assert loader._ledger.count == 0
+    # once the injection budget is spent, the path goes back to the pool
+    assert any("img_001" in p for p in submitted)
+
+
+# ---- consumer starvation --------------------------------------------
+
+
+def test_dead_producer_raises_diagnostic_not_deadlock(
+        fresh_config, tmp_path, monkeypatch):
+    cfg = _small_cfg(fresh_config)
+    cfg.RESILIENCE.DATA.STARVATION_TIMEOUT_SEC = 0.2
+    recs = _disk_records(tmp_path, n=2)
+    loader = _loader(recs, cfg)
+
+    class DeadThread:
+        daemon = True
+
+        def __init__(self, *a, **k):
+            pass
+
+        def start(self):
+            pass  # producer never runs: no batch, no sentinel
+
+        def is_alive(self):
+            return False
+
+        def join(self, timeout=None):
+            pass
+
+    monkeypatch.setattr(threading, "Thread", DeadThread)
+    with pytest.raises(DataStarvationError) as ei:
+        next(iter(loader.batches(1)))
+    msg = str(ei.value)
+    assert "queue depth" in msg and "quarantined" in msg
+
+
+# ---- preflight validation -------------------------------------------
+
+
+def _tiny_coco(tmp_path, mutate=None):
+    from PIL import Image
+
+    base = tmp_path / "data"
+    (base / "train2017").mkdir(parents=True)
+    (base / "annotations").mkdir()
+    rng = np.random.RandomState(0)
+    images, anns = [], []
+    for i in range(3):
+        name = f"t_{i}.jpg"
+        Image.fromarray(rng.randint(0, 255, (30, 40, 3), dtype=np.uint8)
+                        ).save(base / "train2017" / name)
+        images.append({"id": i + 1, "file_name": name,
+                       "height": 30, "width": 40})
+        anns.append({"id": i + 1, "image_id": i + 1, "category_id": 1,
+                     "bbox": [2, 2, 10, 10], "iscrowd": 0, "area": 100,
+                     "segmentation": [[2, 2, 12, 2, 12, 12, 2, 12]]})
+    data = {"images": images, "annotations": anns,
+            "categories": [{"id": 1, "name": "person"}]}
+    if mutate:
+        mutate(data, base)
+    with open(base / "annotations" / "instances_train2017.json",
+              "w") as f:
+        json.dump(data, f)
+    return str(base)
+
+
+def test_unknown_category_skips_and_warns_instead_of_keyerror(
+        tmp_path, caplog):
+    def mutate(data, base):
+        data["annotations"].append(
+            {"id": 99, "image_id": 1, "category_id": 777,
+             "bbox": [1, 1, 5, 5], "iscrowd": 0, "area": 25})
+
+    base = _tiny_coco(tmp_path, mutate)
+    ds = CocoDataset(base, "train2017")  # validate off: record-level guard
+    with caplog.at_level("WARNING"):
+        rec = ds.record(1)
+    assert len(rec["boxes"]) == 1, "unknown-category ann dropped"
+    assert any("unknown category_id 777" in m for m in caplog.messages)
+
+
+def test_strict_mode_raises_on_unknown_category(tmp_path):
+    def mutate(data, base):
+        data["annotations"][0]["category_id"] = 777
+
+    base = _tiny_coco(tmp_path, mutate)
+    with pytest.raises(ValueError, match="unknown category_id 777"):
+        CocoDataset(base, "train2017", validate="strict")
+
+
+def test_malformed_annotations_drop_in_warn_mode(tmp_path, caplog):
+    """Warn mode's contract is drop-and-continue: a bbox of the wrong
+    arity or an annotation missing category_id entirely must not
+    crash record() mid-epoch."""
+    def mutate(data, base):
+        data["annotations"][0]["bbox"] = [1, 2, 3]        # wrong arity
+        del data["annotations"][1]["category_id"]         # missing key
+
+    base = _tiny_coco(tmp_path, mutate)
+    ds = CocoDataset(base, "train2017", validate="warn")
+    with caplog.at_level("WARNING"):
+        recs = ds.records()
+    assert len(recs) == 1  # images 1 and 2 lost their only annotation
+    assert any("malformed bbox" in m for m in caplog.messages)
+    assert any("unknown category_id None" in m for m in caplog.messages)
+    with pytest.raises(ValueError, match="dataset issue"):
+        CocoDataset(base, "train2017", validate="strict")
+
+
+def test_malformed_segmentation_drops_in_warn_mode(tmp_path, caplog):
+    """A malformed polygon must not crash the mask rasterizer deep in
+    a decode thread (the warn-mode contract covers every
+    user-supplied field, not just bbox/category)."""
+    def mutate(data, base):
+        data["annotations"][0]["segmentation"] = [[1, 2, 3]]  # odd len
+        data["annotations"][1]["segmentation"] = 42           # not a seg
+
+    base = _tiny_coco(tmp_path, mutate)
+    ds = CocoDataset(base, "train2017", validate="warn")
+    with caplog.at_level("WARNING"):
+        recs = ds.records()
+    assert len(recs) == 1  # images 1 and 2 lost their only annotation
+    assert sum("malformed segmentation" in m
+               for m in caplog.messages) >= 2
+    with pytest.raises(ValueError, match="dataset issue"):
+        CocoDataset(base, "train2017", validate="strict")
+
+
+def test_preflight_catches_degenerate_and_missing(tmp_path):
+    def mutate(data, base):
+        data["annotations"][0]["bbox"] = [5, 5, 0, 10]   # w == 0
+        data["images"].append({"id": 9, "file_name": "absent.jpg",
+                               "height": 30, "width": 40})
+        os.remove(base / "train2017" / "t_2.jpg")
+
+    base = _tiny_coco(tmp_path, mutate)
+    issues = CocoDataset(base, "train2017").preflight(sample_files=16)
+    text = "\n".join(issues)
+    assert "degenerate bbox" in text
+    assert "file-existence probe" in text
+    with pytest.raises(ValueError, match="dataset issue"):
+        CocoDataset(base, "train2017", validate="strict")
+
+
+def test_invalid_image_entry_survives_warn_mode(tmp_path, caplog):
+    """An image row with no file_name must not crash preflight's
+    probe, records(), or record() — warn mode reports and skips."""
+    def mutate(data, base):
+        data["images"].append({"id": 9, "height": 30, "width": 40})
+
+    base = _tiny_coco(tmp_path, mutate)
+    with caplog.at_level("WARNING"):
+        ds = CocoDataset(base, "train2017", validate="warn")
+        recs = ds.records()
+    assert all(r["image_id"] != 9 for r in recs)
+    with pytest.raises(ValueError, match="cannot build a record"):
+        ds.record(9)
+
+
+def test_warn_mode_logs_and_continues(tmp_path, caplog):
+    def mutate(data, base):
+        data["annotations"][0]["bbox"] = [5, 5, 0, 10]
+
+    base = _tiny_coco(tmp_path, mutate)
+    with caplog.at_level("WARNING"):
+        ds = CocoDataset(base, "train2017", validate="warn")
+    assert any("dataset issue" in m for m in caplog.messages)
+    # record() drops the degenerate ann; its image (now annotation-less)
+    # falls out of the skip_empty record list — 2 clean records remain
+    assert len(ds.records()) == 2

@@ -22,10 +22,30 @@ resilience layer (eksml_tpu/resilience/); each rung here drives a real
                       rolls back to the last good step, and the run
                       still completes.
 
-All rungs are ``chaos`` + ``slow`` (each launches 1-2 subprocess
-trainers; the module-shared compile cache keeps the total to ONE tiny
-XLA compile).  tools/chaos_matrix.sh runs the ladder with a per-rung
-summary; the fast in-process halves live in tests/test_resilience.py.
+Data-ingest rungs (eksml_tpu/data/robust.py, ISSUE 2):
+
+  data-corrupt-jpeg   a truncated JPEG on the shared filesystem is
+                      quarantined + substituted; the run continues.
+  data-missing-file   a partially-staged (absent) image likewise.
+  data-eio-recover    an injected transient EIO (NFS blip) retries
+                      and recovers with ZERO quarantine trace.
+  data-broken-pool    a decode worker dies (OOM kill); the affected
+                      batch is re-read inline (quarantine only on
+                      real decode evidence), the pool rebuilt once.
+  proc-data-chaos     all three data faults in ONE 20-step on-disk
+                      training run: completes with unchanged batch
+                      shapes; the ledger lists exactly the two
+                      permanent failures.
+  proc-data-breaker   quarantine fraction forced above
+                      MAX_QUARANTINE_FRAC: the run aborts with an
+                      actionable error naming the ledger path.
+
+Subprocess rungs are ``chaos`` + ``slow`` (each launches 1-2
+subprocess trainers; the module-shared compile cache keeps the total
+to ONE tiny XLA compile); the in-process data rungs are ``chaos``
+only.  tools/chaos_matrix.sh runs the ladder with a per-rung summary;
+the fast unit halves live in tests/test_resilience.py and
+tests/test_data_robust.py.
 """
 
 import json
@@ -57,17 +77,19 @@ def compile_cache(tmp_path_factory):
     return str(tmp_path_factory.mktemp("xla_cache"))
 
 
-def _launch(logdir, cache_dir, log_path, config=TINY):
+def _launch(logdir, cache_dir, log_path, config=TINY, synthetic=True):
     env = dict(os.environ)
     env.update({"EKSML_PLATFORM": "cpu",
                 "JAX_COMPILATION_CACHE_DIR": cache_dir})
+    cmd = [sys.executable, "-m", "eksml_tpu.train", "--logdir", logdir]
+    if synthetic:
+        cmd.append("--synthetic")
+    cmd += ["--config"] + config
     # child output goes to a FILE: an undrained PIPE fills (~64KB) with
     # XLA chatter and deadlocks the child mid-compile
     with open(log_path, "w") as logf:  # child inherits the fd
         return subprocess.Popen(
-            [sys.executable, "-m", "eksml_tpu.train", "--logdir", logdir,
-             "--synthetic", "--config"] + config,
-            env=env, stdout=logf, stderr=subprocess.STDOUT,
+            cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
             cwd=os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))
 
@@ -320,3 +342,214 @@ def test_nan_loss_rolls_back_and_never_checkpoints_poison(
     # every committed checkpoint postdates recovery or predates the
     # poison: 2 (pre-poison), 4 and 6 (re-run); none from the window
     assert _committed_ckpt_steps(logdir) == [2, 4, 6]
+
+
+# ---- rungs 5-7: data-ingest faults (loader level, in-process) --------
+
+
+@pytest.mark.parametrize("fault", ["corrupt-jpeg", "missing-file",
+                                   "eio-recover"])
+def test_data_fault_rung(fault, fresh_config, tmp_path):
+    """One bad record must cost ONE quarantine entry (or none, for a
+    recovered transient) — never the producer thread and the job."""
+    from test_data_robust import _disk_records, _loader, _small_cfg
+
+    cfg = _small_cfg(fresh_config)
+    recs = _disk_records(tmp_path)
+    victim = recs[1]["path"]
+    expect_kind = None
+    if fault == "corrupt-jpeg":
+        with open(victim, "wb") as f:
+            f.write(b"\xff\xd8\xff\xe0 truncated mid-stage")
+        expect_kind = "decode"
+    elif fault == "missing-file":
+        os.remove(victim)
+        expect_kind = "missing"
+    else:  # eio-recover: one injected NFS blip, then healthy
+        cfg.RESILIENCE.DATA.FAULT_INJECT_EIO_PATH = \
+            os.path.basename(victim)
+        cfg.RESILIENCE.DATA.FAULT_INJECT_EIO_COUNT = 1
+        cfg.RESILIENCE.DATA.IO_BACKOFF_SEC = 0.001
+
+    loader = _loader(recs, cfg, ledger_dir=str(tmp_path / "log"))
+    batches = list(loader.batches(8))  # 16 draws: every record hit
+    assert len(batches) == 8
+    assert all(b["images"].shape == (2, 64, 64, 3) for b in batches)
+    if expect_kind is None:
+        assert loader._ledger.count == 0, (
+            "recovered transient must leave no quarantine trace")
+        assert loader._reader.transient_recoveries == 1
+    else:
+        assert [e["kind"] for e in loader._ledger.entries] == [
+            expect_kind]
+        assert loader._ledger.entries[0]["path"] == victim
+
+
+# ---- rung 8: BrokenProcessPool self-healing --------------------------
+
+
+def test_broken_pool_rebuilds_and_continues(fresh_config, tmp_path,
+                                            monkeypatch):
+    """A decode worker OOM-killed mid-batch breaks the whole
+    ProcessPoolExecutor.  The loader must re-read the affected batch
+    inline (a pool break is evidence about the POOL, not any record's
+    bytes — only an inline failure quarantines), rebuild the pool
+    once, and keep producing — not abort the N-host job over one dead
+    worker.  Once the rebuild budget is spent, degradation to
+    in-thread decode is sticky across batches() calls."""
+    from concurrent.futures.process import BrokenProcessPool
+
+    from test_data_robust import (_disk_records, _loader, _small_cfg,
+                                  _truncate)
+
+    cfg = _small_cfg(fresh_config)
+    cfg.DATA.WORKER_PROCESSES = 2  # enables the decode process pool
+    recs = _disk_records(tmp_path)
+    _truncate(recs[0]["path"])  # genuinely bad bytes, surfaced inline
+    loader = _loader(recs, cfg)
+
+    class FakeFuture:
+        def __init__(self, fn, broken):
+            self._fn, self._broken = fn, broken
+
+        def result(self):
+            if self._broken:
+                raise BrokenProcessPool("a decode worker died")
+            return self._fn()
+
+    class FakePool:
+        def __init__(self, broken):
+            self.broken = broken
+
+        def submit(self, fn, path):
+            return FakeFuture(lambda: fn(path), self.broken)
+
+        def shutdown(self, wait=False, cancel_futures=False):
+            pass
+
+    made = []
+
+    def make_pool():
+        pool = FakePool(broken=(len(made) == 0))  # first pool breaks
+        made.append(pool)
+        return pool
+
+    monkeypatch.setattr(loader, "_make_proc_pool", make_pool)
+    batches = list(loader.batches(8))  # 16 draws: every record hit
+    assert len(batches) == 8
+    assert all(b["images"].shape == (2, 64, 64, 3) for b in batches)
+    assert len(made) == 2, "pool must be rebuilt exactly once"
+    # only the record whose bytes REALLY fail is quarantined —
+    # healthy records that rode the broken batch re-read inline and
+    # survive
+    assert [e["kind"] for e in loader._ledger.entries] == ["decode"]
+    assert loader._ledger.entries[0]["path"] == recs[0]["path"]
+
+    # from here every pool breaks: the next incident exhausts the
+    # rebuild budget → sticky in-thread degradation
+    def make_broken_pool():
+        pool = FakePool(broken=True)
+        made.append(pool)
+        return pool
+
+    monkeypatch.setattr(loader, "_make_proc_pool", make_broken_pool)
+    assert len(list(loader.batches(4))) == 4
+    assert loader._pool_degraded
+    n_pools = len(made)
+    assert len(list(loader.batches(2))) == 2  # re-iterate after close
+    assert len(made) == n_pools, (
+        "a later batches() call must not resurrect a degraded pool")
+
+
+# ---- rung 9: the composed data-chaos training run --------------------
+
+
+@pytest.mark.slow
+def test_data_chaos_train_completes_with_quarantine(
+        tmp_path, compile_cache, mini_coco):
+    """Acceptance rung (ISSUE 2): corrupt JPEG + missing file + one
+    injected transient EIO in a single 20-step on-disk training run →
+    the run completes with unchanged batch shapes, the quarantine
+    ledger lists exactly the two permanent failures, and the recovered
+    transient leaves zero entries."""
+    logdir = str(tmp_path / "run")
+    img_dir = os.path.join(mini_coco, "train2017")
+    corrupt = os.path.join(img_dir, "train2017_000.jpg")
+    with open(corrupt, "wb") as f:
+        f.write(b"\xff\xd8\xff\xe0 truncated mid-stage")
+    os.remove(os.path.join(img_dir, "train2017_001.jpg"))
+
+    config = [c for c in TINY
+              if "STEPS_PER_EPOCH" not in c and "MAX_EPOCHS" not in c
+              ] + [
+        "TRAIN.STEPS_PER_EPOCH=20", "TRAIN.MAX_EPOCHS=1",
+        "TRAIN.LOG_PERIOD=5",
+        f"DATA.BASEDIR={mini_coco}",
+        "PREPROC.TEST_SHORT_EDGE_SIZE=128",
+        # 6 records, 2 permanent failures = 0.33 — under the breaker
+        "RESILIENCE.DATA.MAX_QUARANTINE_FRAC=0.4",
+        "RESILIENCE.DATA.IO_BACKOFF_SEC=0.05",
+        "RESILIENCE.DATA.FAULT_INJECT_EIO_PATH=train2017_002",
+        "RESILIENCE.DATA.FAULT_INJECT_EIO_COUNT=1",
+    ]
+    log1 = str(tmp_path / "run1.log")
+    proc = _launch(logdir, compile_cache, log1, config, synthetic=False)
+    try:
+        rc = proc.wait(timeout=900)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = open(log1).read()
+    assert rc == 0, out[-3000:]
+    assert "training complete at 20 steps" in out
+    # preflight (warn mode) flagged the missing file before step 1
+    assert "file-existence probe" in out
+    # the ledger is a census of exactly the two permanent failures
+    ledger_path = os.path.join(logdir, "quarantine-host0.jsonl")
+    entries = [json.loads(l) for l in open(ledger_path)]
+    kinds = {os.path.basename(e["path"]): e["kind"] for e in entries}
+    assert kinds == {"train2017_000.jpg": "decode",
+                     "train2017_001.jpg": "missing"}, entries
+    # the injected transient recovered — logged, not quarantined
+    assert "recovered after" in out
+    # 20 steps of metrics with the quarantine census riding along
+    steps = _steps_logged(logdir)
+    assert max(steps) == 20, steps
+    assert any(r.get("data/quarantined") == 2
+               for r in _metric_rows(logdir))
+
+
+# ---- rung 10: the quarantine circuit breaker -------------------------
+
+
+@pytest.mark.slow
+def test_quarantine_overflow_aborts_actionably(tmp_path, compile_cache,
+                                               mini_coco):
+    """With the quarantined fraction forced above MAX_QUARANTINE_FRAC
+    (a vanished mount in miniature: every image truncated), the run
+    must abort with an actionable error naming the ledger path — not
+    train on substitutes."""
+    logdir = str(tmp_path / "run")
+    img_dir = os.path.join(mini_coco, "train2017")
+    for name in os.listdir(img_dir):
+        with open(os.path.join(img_dir, name), "wb") as f:
+            f.write(b"not a jpeg anymore")
+
+    config = TINY + [
+        f"DATA.BASEDIR={mini_coco}",
+        "RESILIENCE.DATA.MAX_QUARANTINE_FRAC=0.1",
+    ]
+    log1 = str(tmp_path / "run1.log")
+    proc = _launch(logdir, compile_cache, log1, config, synthetic=False)
+    try:
+        rc = proc.wait(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = open(log1).read()
+    from eksml_tpu.config import config as global_config
+
+    assert rc not in (0, global_config.RESILIENCE.PREEMPT_EXIT_CODE), (
+        rc, out[-2000:])
+    assert "MAX_QUARANTINE_FRAC" in out
+    assert os.path.join(logdir, "quarantine-host0.jsonl") in out

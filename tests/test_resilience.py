@@ -83,6 +83,26 @@ def test_watchdog_first_deadline_stretched_for_compile(tmp_path):
     assert wd.fires >= 1
 
 
+def test_watchdog_report_includes_provider_sections(tmp_path):
+    """Registered report providers (the data loader's health surface)
+    must land in the hang report — and a crashing provider must be
+    contained, never suppress the report itself."""
+    wd = HangWatchdog(0.2, report_dir=str(tmp_path),
+                      first_beat_factor=1.0)
+    wd.add_report_provider(
+        "data pipeline", lambda: "queue depth: 3\nquarantined: 1")
+    wd.add_report_provider("broken provider", lambda: 1 / 0)
+    with wd:
+        wd.beat("next_batch", 5)
+        time.sleep(0.6)
+    assert wd.reports
+    report = open(wd.reports[0]).read()
+    assert "--- data pipeline ---" in report
+    assert "queue depth: 3" in report and "quarantined: 1" in report
+    assert "report provider failed" in report
+    assert "stalled phase: next_batch" in report
+
+
 def test_watchdog_on_hang_escalation(tmp_path):
     fired = []
     wd = HangWatchdog(0.2, report_dir=str(tmp_path), first_beat_factor=1.0,
@@ -177,6 +197,54 @@ def test_truncated_file_fails_verification(tmp_path):
     open(victim, "w").close()  # truncate to 0 bytes
     ok, reason = integrity.verify_step(ckpt.directory, 3)
     assert not ok and "truncated" in reason
+
+
+def test_transient_io_error_during_verification_is_retried(
+        tmp_path, monkeypatch):
+    """An NFS blip while *verifying* a manifest-listed file is
+    evidence about the MOUNT, not the step's bytes: retry and verify —
+    neither crash the relaunch nor hand the caller a false corruption
+    verdict (which would quarantine a good checkpoint)."""
+    import errno
+
+    ckpt, _ = _save_steps(tmp_path)
+    victim = _step_files(ckpt, 3)[0]
+    real_getsize = os.path.getsize
+    fails = {"left": 2}
+
+    def flaky_getsize(path):
+        if path == victim and fails["left"] > 0:
+            fails["left"] -= 1
+            raise OSError(errno.EIO, "Input/output error", path)
+        return real_getsize(path)
+
+    monkeypatch.setattr(os.path, "getsize", flaky_getsize)
+    ok, reason = integrity.verify_step(ckpt.directory, 3)
+    assert ok and "verified" in reason
+
+
+def test_persistent_io_error_during_verification_raises_not_quarantines(
+        tmp_path, monkeypatch):
+    """A mount outage mid-verification must crash the relaunch (the
+    orchestrator retries later) rather than return a corruption
+    verdict — quarantining on unreachable-file evidence would let one
+    outage destroy every good checkpoint newest-first."""
+    import errno
+
+    ckpt, _ = _save_steps(tmp_path)
+    victim = _step_files(ckpt, 3)[0]
+    real_getsize = os.path.getsize
+
+    def dead_mount_getsize(path):
+        if path == victim:
+            raise OSError(errno.ESTALE, "Stale file handle", path)
+        return real_getsize(path)
+
+    monkeypatch.setattr(os.path, "getsize", dead_mount_getsize)
+    with pytest.raises(RuntimeError, match="verifying checkpoint"):
+        integrity.verify_step(ckpt.directory, 3)
+    # the step dir was NOT quarantined out of the digit namespace
+    assert os.path.isdir(os.path.join(ckpt.directory, "3"))
 
 
 def test_restore_walks_back_past_corrupt_latest(tmp_path):

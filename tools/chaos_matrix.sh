@@ -2,12 +2,15 @@
 # Run the full chaos ladder locally with a per-rung pass/fail summary.
 #
 # Every rung drives one failure mode of the resilience layer
-# (eksml_tpu/resilience/, ISSUE: graceful preemption / checkpoint
-# integrity / divergence sentinel / hang watchdog).  The subprocess
-# rungs launch real `python -m eksml_tpu.train` processes and are
-# marked slow (excluded from tier-1); the unit rungs run in seconds.
-# Everything runs under JAX_PLATFORMS=cpu with the tiny-model
-# overrides, sharing ONE XLA compile via the module-scoped cache.
+# (eksml_tpu/resilience/: graceful preemption / checkpoint integrity /
+# divergence sentinel / hang watchdog) or of the fault-tolerant data
+# ingest (eksml_tpu/data/robust.py: quarantine + substitution /
+# bounded I/O retry / decode-pool self-healing / starvation watchdog).
+# The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
+# processes and are marked slow (excluded from tier-1); the unit and
+# data-* rungs run in seconds.  Everything runs under
+# JAX_PLATFORMS=cpu with the tiny-model overrides, sharing ONE XLA
+# compile via the module-scoped cache.
 #
 # Usage:  tools/chaos_matrix.sh [--fast]
 #   --fast   unit rungs only (skip the subprocess trainer rungs)
@@ -25,10 +28,17 @@ RUNGS=(
   "unit-ckpt-integrity|tests/test_resilience.py -k 'manifest or corrupt or truncated or digest or fatal or all_steps'"
   "unit-preemption|tests/test_resilience.py -k preemption"
   "unit-init-retry|tests/test_resilience.py tests/test_distributed.py -k 'retry or retries or exhaustion'"
+  "unit-data-robust|tests/test_data_robust.py"
+  "data-corrupt-jpeg|'tests/test_fault_tolerance.py::test_data_fault_rung[corrupt-jpeg]'"
+  "data-missing-file|'tests/test_fault_tolerance.py::test_data_fault_rung[missing-file]'"
+  "data-eio-recover|'tests/test_fault_tolerance.py::test_data_fault_rung[eio-recover]'"
+  "data-broken-pool|tests/test_fault_tolerance.py::test_broken_pool_rebuilds_and_continues"
   "proc-sigkill-resume|tests/test_fault_tolerance.py::test_sigkill_then_resume"
   "proc-sigterm-graceful|tests/test_fault_tolerance.py::test_sigterm_graceful_preempt_then_resume"
   "proc-corrupt-latest|tests/test_fault_tolerance.py::test_corrupt_latest_checkpoint_falls_back"
   "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"
+  "proc-data-chaos|tests/test_fault_tolerance.py::test_data_chaos_train_completes_with_quarantine"
+  "proc-data-breaker|tests/test_fault_tolerance.py::test_quarantine_overflow_aborts_actionably"
 )
 
 declare -a NAMES RESULTS TIMES

@@ -104,9 +104,16 @@ EOF
 )
     say "ladder attempt $i: $ok ($(echo "$line" | head -c 160))"
     if [ "$ok" = "good" ]; then
-        ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-        echo "$line" \
-            | sed "s/}\$/, \"banked_at\": \"$ts\"}/" > BENCH_LOCAL.json
+        # append banked_at via json load/dump like the other harvest
+        # steps — sed on the raw line silently banked corrupted (or
+        # timestamp-less) JSON whenever the line wasn't }-terminated
+        ts=$(date -u +%Y-%m-%dT%H:%M:%SZ) python - "$line" <<'EOF' \
+            > .bench_r5c.banked.tmp && mv .bench_r5c.banked.tmp BENCH_LOCAL.json
+import json, os, sys
+d = json.loads(sys.argv[1])
+d["banked_at"] = os.environ["ts"]
+json.dump(d, sys.stdout)
+EOF
         ladder_ok=1
         break
     fi
