@@ -232,8 +232,24 @@ def main(argv=None):
                         "--image-size pad")
     p.add_argument("--precision", default="bfloat16",
                    choices=["bfloat16", "float32"])
-    p.add_argument("--remat", action="store_true",
-                   help="rematerialize backbone/FPN (TRAIN.REMAT)")
+    # nargs="?"/const=1 keeps the legacy bare `--remat` spelling while
+    # exposing the per-change A/B form (`--remat 0`, `--remat 1`)
+    p.add_argument("--remat", type=int, nargs="?", const=1, default=0,
+                   choices=(0, 1),
+                   help="rematerialize backbone/FPN (TRAIN.REMAT); "
+                        "A/B switch (0/1, bare flag = 1)")
+    p.add_argument("--param-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="param + optimizer-state storage dtype "
+                        "(TRAIN.PARAM_DTYPE); bfloat16 halves the "
+                        "state HBM — the 1344/b8 memory plan")
+    p.add_argument("--prefetch", type=int, default=-1,
+                   choices=(-1, 0, 1),
+                   help="input-pipeline A/B: -1 = one device-resident "
+                        "batch (legacy, measures pure step time); 0 = "
+                        "synchronous host->device transfer every step; "
+                        "1 = async double-buffered DevicePrefetcher "
+                        "(overlaps the transfer with compute)")
     p.add_argument("--roi-backend", default="auto",
                    choices=["auto", "pallas", "xla"],
                    help="A/B switch for the ROIAlign kernel "
@@ -367,8 +383,16 @@ RUNGS = (
      "batch_size": 4},
     {"name": "1344_b4", "image_size": 1344, "pad_hw": None,
      "batch_size": 4},
+    # the batch-8 memory plan (VERDICT r5 next #7): remat + bf16
+    # param/optimizer storage buy the HBM for b8 at the flagship
+    # canvas — the operating point the bucketed 832x1344 rung (13.08
+    # img/s/chip) says has headroom over the b4 headline
+    {"name": "1344_b8_remat", "image_size": 1344, "pad_hw": None,
+     "batch_size": 8, "remat": True, "param_dtype": "bfloat16"},
 )
-HEADLINE_RUNG = "1344_b4"
+# rungs whose success counts as "the headline point ran" — the b4
+# flagship and the b8 memory-plan point are both production-legal
+HEADLINE_RUNGS = ("1344_b4", "1344_b8_remat")
 
 
 def run_ladder(args, diag: dict) -> None:
@@ -421,8 +445,11 @@ def run_ladder(args, diag: dict) -> None:
             ra.warmup = rung["warmup"]
         # once a rung needed remat, every LARGER rung starts with it:
         # re-paying a doomed non-remat compile over a flaky tunnel is
-        # exactly the window-burning this ladder exists to avoid
-        ra.remat = carry_remat
+        # exactly the window-burning this ladder exists to avoid.
+        # A rung can also REQUIRE remat / bf16 params (the b8 memory
+        # plan ships as one pre-planned operating point).
+        ra.remat = 1 if (carry_remat or rung.get("remat")) else 0
+        ra.param_dtype = rung.get("param_dtype", args.param_dtype)
         rdiag = {
             "metric": ("maskrcnn_r50fpn_fwd_microbench"
                        if ra.forward_only else diag["metric"]),
@@ -471,7 +498,7 @@ def run_ladder(args, diag: dict) -> None:
     if best is not None:
         diag.update(best)
         diag["headline_point"] = (
-            best.get("operating_point") == HEADLINE_RUNG)
+            best.get("operating_point") in HEADLINE_RUNGS)
     else:
         # no rung landed: surface the failure at top level so the
         # driver's recorded line is self-diagnosing, and carry the last
@@ -481,6 +508,41 @@ def run_ladder(args, diag: dict) -> None:
         diag["trace_tail"] = abort.get("trace_tail", [])
         _attach_last_good(diag)
     diag["rungs"] = rung_summaries
+
+
+def _bank_attribution(step, diag: dict) -> None:
+    """--profile companion artifacts (VERDICT r5 next #5): the compiled
+    HLO text and its instruction→component attribution land next to the
+    trace, so ``tools/trace_summary.py --attribution`` can name every
+    fusion the trace times.  Best-effort: a failure here must never
+    destroy the measured result."""
+    import sys as _sys
+
+    try:
+        hlo = step.as_text()  # AOT-compiled executable only
+    except Exception as e:  # noqa: BLE001 — jit fallback has no text
+        print(f"bench: no compiled HLO for attribution ({e})",
+              file=_sys.stderr)
+        return
+    try:
+        from eksml_tpu.profiling import write_attribution_artifact
+
+        os.makedirs("profile", exist_ok=True)
+        with open(os.path.join("profile", "hlo.txt"), "w") as f:
+            f.write(hlo)
+        payload = write_attribution_artifact(
+            hlo, os.path.join("profile", "attribution.json"),
+            extra={"operating_point": diag.get("operating_point"),
+                   "image_size": diag.get("image_size"),
+                   "batch_size": diag.get("batch_size")})
+        table = payload["component_table"]
+        diag["component_pct"] = table["component_pct"]
+        diag["component_other_pct"] = table["other_pct"]
+        print("bench: attribution banked to profile/attribution.json "
+              f"(modeled other {table['other_pct']}%)",
+              file=_sys.stderr)
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        print(f"bench: attribution failed: {e}", file=_sys.stderr)
 
 
 def run(args, diag: dict) -> None:
@@ -514,7 +576,8 @@ def run(args, diag: dict) -> None:
     size = max(args.pad_hw) if args.pad_hw else args.image_size
     cfg.freeze(False)
     cfg.TRAIN.PRECISION = args.precision
-    cfg.TRAIN.REMAT = args.remat
+    cfg.TRAIN.REMAT = bool(args.remat)
+    cfg.TRAIN.PARAM_DTYPE = getattr(args, "param_dtype", "float32")
     cfg.TRAIN.BATCH_SIZE_PER_CHIP = args.batch_size
     cfg.PREPROC.MAX_SIZE = size
     cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (size, size)
@@ -543,14 +606,30 @@ def run(args, diag: dict) -> None:
     fwd_only = getattr(args, "forward_only", False)
     model = MaskRCNN.from_config(cfg)
 
-    batch = make_synthetic_batch(cfg, batch_size=args.batch_size,
-                                 image_size=shape)
-    batch = {k: jnp.asarray(v) for k, v in batch.items()
-             if k not in ("image_scale", "image_id")}
+    # input-pipeline A/B (--prefetch): a small pool of DISTINCT host
+    # batches cycled through the step loop, so transfer modes measure
+    # real per-step H2D traffic instead of a cached resident buffer
+    prefetch = getattr(args, "prefetch", -1)
+    host_batches = None
+    if prefetch >= 0:
+        host_batches = [
+            {k: v for k, v in make_synthetic_batch(
+                cfg, batch_size=args.batch_size, image_size=shape,
+                seed=s).items() if k not in ("image_scale", "image_id")}
+            for s in range(4)]
+        batch = jax.device_put(host_batches[0])
+    else:
+        batch = make_synthetic_batch(cfg, batch_size=args.batch_size,
+                                     image_size=shape)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()
+                 if k not in ("image_scale", "image_id")}
 
     rng = jax.random.PRNGKey(0)
     t0 = time.time()
     params = jax.jit(lambda r, b: model.init(r, b, r)["params"])(rng, batch)
+    from eksml_tpu.train import cast_params_for_storage
+
+    params = cast_params_for_storage(params, cfg.TRAIN.PARAM_DTYPE)
     if not fwd_only:
         # the micro rung never touches the optimizer — skip allocating
         # param-tree-sized momentum buffers on the device exactly where
@@ -558,6 +637,33 @@ def run(args, diag: dict) -> None:
         tx, _ = make_optimizer(cfg)
         opt_state = tx.init(params)
     print(f"bench: init in {time.time() - t0:.1f}s", file=sys.stderr)
+
+    # per-step batch source for the transfer A/B modes
+    prefetcher = None
+    if prefetch < 0:
+        def next_batch():
+            return batch
+    elif prefetch == 0:
+        import itertools
+
+        host_it = itertools.cycle(host_batches)
+
+        def next_batch():
+            # synchronous transfer on the step critical path — the
+            # baseline the prefetcher is measured against
+            b = jax.device_put(next(host_it))
+            jax.block_until_ready(b)
+            return b
+    else:
+        import itertools
+
+        from eksml_tpu.data.loader import DevicePrefetcher
+
+        prefetcher = DevicePrefetcher(itertools.cycle(host_batches),
+                                      jax.device_put)
+
+        def next_batch():
+            return next(prefetcher)
 
     if fwd_only:
         # rung-0 microbench: time the forward losses alone — no grad,
@@ -573,7 +679,8 @@ def run(args, diag: dict) -> None:
         lower_args = (params, batch, rng)
 
         def run_step(i):
-            return step(params, batch, jax.random.fold_in(rng, i))
+            return step(params, next_batch(),
+                        jax.random.fold_in(rng, i))
     else:
         def train_step(params, opt_state, batch, rng):
             def loss_fn(p):
@@ -581,16 +688,19 @@ def run(args, diag: dict) -> None:
                 return losses["total_loss"], losses
 
             grads, losses = jax.grad(loss_fn, has_aux=True)(params)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), new_opt,
-                    losses["total_loss"])
+            # scope → "optimizer" in the profiling attribution
+            with jax.named_scope("optimizer"):
+                updates, new_opt = tx.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), new_opt,
+                        losses["total_loss"])
 
         step = jax.jit(train_step, donate_argnums=(0, 1))
         lower_args = (params, opt_state, batch, rng)
 
         def run_step(i):
             nonlocal params, opt_state
-            params, opt_state, loss = step(params, opt_state, batch,
+            params, opt_state, loss = step(params, opt_state,
+                                           next_batch(),
                                            jax.random.fold_in(rng, i))
             return loss
 
@@ -598,38 +708,59 @@ def run(args, diag: dict) -> None:
     # nowhere").  cost_analysis counts the actual fused program, a
     # better estimate than a hand model of the architecture.  The AOT
     # executable REPLACES the jit dispatch (compiling once, not twice).
+    # The try/finally closes the prefetcher on EVERY exit: an HBM OOM
+    # here must not leak the transfer thread + its queued device
+    # batches into _run_with_remat's retry compile (which runs within
+    # ~0.5G of capacity by definition).
     flops_per_step = None
     try:
-        compiled = step.lower(*lower_args).compile()
-        cost = compiled.cost_analysis()
-        if cost:
-            flops_per_step = float(cost.get("flops", 0.0)) or None
-        step = compiled
-    except Exception as e:  # noqa: BLE001 — MFU is best-effort
-        print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
+        try:
+            compiled = step.lower(*lower_args).compile()
+            # adopt the AOT executable FIRST: even if cost_analysis
+            # below throws (CPU jaxlib returns a bare list), the
+            # compiled module must stay reachable for --profile's HLO
+            # attribution dump
+            step = compiled
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            if cost:
+                flops_per_step = float(cost.get("flops", 0.0)) or None
+        except Exception as e:  # noqa: BLE001 — MFU is best-effort
+            print(f"bench: cost_analysis unavailable: {e}",
+                  file=sys.stderr)
 
-    t0 = time.time()
-    for i in range(args.warmup):
-        loss = run_step(i)
-    jax.block_until_ready(loss)
-    print(f"bench: compile+warmup in {time.time() - t0:.1f}s "
-          f"(loss={float(loss):.3f})", file=sys.stderr)
-
-    t0 = time.time()
-    for i in range(args.steps):
-        loss = run_step(100 + i)
-    jax.block_until_ready(loss)
-    dt = time.time() - t0
-
-    if args.profile:
-        # separate profiled segment AFTER timing — trace serialization
-        # must not pollute the headline images/sec/chip or mfu
-        jax.profiler.start_trace("profile")
-        for i in range(args.profile):
-            loss = run_step(500 + i)
+        t0 = time.time()
+        for i in range(args.warmup):
+            loss = run_step(i)
         jax.block_until_ready(loss)
-        jax.profiler.stop_trace()
-        print("bench: trace written to ./profile/", file=sys.stderr)
+        print(f"bench: compile+warmup in {time.time() - t0:.1f}s "
+              f"(loss={float(loss):.3f})", file=sys.stderr)
+
+        t0 = time.time()
+        for i in range(args.steps):
+            loss = run_step(100 + i)
+        jax.block_until_ready(loss)
+        dt = time.time() - t0
+
+        if args.profile:
+            # separate profiled segment AFTER timing — trace
+            # serialization must not pollute the headline
+            # images/sec/chip or mfu
+            jax.profiler.start_trace("profile")
+            for i in range(args.profile):
+                loss = run_step(500 + i)
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            print("bench: trace written to ./profile/", file=sys.stderr)
+            _bank_attribution(step, diag)
+    finally:
+        if prefetcher is not None:
+            # time the step loop spent BLOCKED on the next device
+            # batch — ~0 means the transfer fully overlapped compute
+            diag["prefetch_wait_ms"] = round(
+                prefetcher.wait_ms_ewma or 0.0, 2)
+            prefetcher.close()
 
     assert np.isfinite(float(loss)), f"non-finite loss {float(loss)}"
     imgs_per_sec = args.steps * args.batch_size / dt
@@ -637,6 +768,8 @@ def run(args, diag: dict) -> None:
     step_ms = dt / args.steps * 1000
 
     diag["value"] = round(per_chip, 3)
+    diag["prefetch"] = prefetch
+    diag["param_dtype"] = cfg.TRAIN.PARAM_DTYPE
     # a forward-only number must not be ratioed against the
     # train-throughput anchor — leave vs_baseline at 0 for the micro
     # rung (its value/mfu stand on their own, clearly labeled)

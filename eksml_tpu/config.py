@@ -300,6 +300,18 @@ def _define_defaults() -> None:
     # trades FLOPs for HBM, the lever that buys batch-4/chip at 1344px
     # (no reference equivalent; V100s just had the memory)
     _C.TRAIN.REMAT = False
+    # param + optimizer-state STORAGE dtype ("bfloat16" halves the
+    # ~360 MB of f32 state HBM at R50-FPN scale — with REMAT, the
+    # memory plan that fits batch-8/chip at 1344px).  Compute precision
+    # stays TRAIN.PRECISION; losses/updates tolerate bf16 state to the
+    # dtype's resolution (dryrun parity pinned in tests)
+    _C.TRAIN.PARAM_DTYPE = "float32"
+    # overlap the next batch's host-shard -> device_put with the
+    # current step's compute (data/loader.py DevicePrefetcher).  Batch
+    # order is unchanged, so losses are bit-identical ON or OFF; the
+    # step loop's residual blocking rides the metric stream as
+    # data/prefetch_wait_ms.  False = legacy synchronous transfer.
+    _C.TRAIN.PREFETCH_TO_DEVICE = True
     _C.TRAIN.LOGDIR = "/tmp/eksml_tpu/train_log/maskrcnn"
 
     # ---- TPU / comm layer (≙ HOROVOD_*/NCCL_* env, values.yaml:24-28)
@@ -393,6 +405,8 @@ def finalize_configs(is_training: bool) -> AttrDict:
 
     assert _C.BACKBONE.NORM in ("FreezeBN", "GN"), _C.BACKBONE.NORM
     assert _C.TRAIN.PRECISION in ("float32", "bfloat16"), _C.TRAIN.PRECISION
+    assert _C.TRAIN.PARAM_DTYPE in ("float32", "bfloat16"), (
+        _C.TRAIN.PARAM_DTYPE)
     assert _C.RESILIENCE.DATA.VALIDATE in ("off", "warn", "strict"), (
         _C.RESILIENCE.DATA.VALIDATE)
     assert len(_C.FPN.ANCHOR_STRIDES) == len(_C.RPN.ANCHOR_SIZES)

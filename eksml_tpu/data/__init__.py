@@ -15,7 +15,8 @@ eks-cluster/stage-data.yaml:30-36, charts/maskrcnn/values.yaml:13).
 
 from eksml_tpu.data.coco import CocoDataset  # noqa: F401
 from eksml_tpu.data.loader import (  # noqa: F401
-    DetectionLoader, SyntheticDataset, make_synthetic_batch)
+    DetectionLoader, DevicePrefetcher, SyntheticDataset,
+    make_synthetic_batch)
 from eksml_tpu.data.masks import (  # noqa: F401
     polygons_to_bbox_mask, rle_decode, rle_encode)
 from eksml_tpu.data.robust import (  # noqa: F401

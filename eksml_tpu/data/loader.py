@@ -749,6 +749,135 @@ class DetectionLoader:
             self.health.producer_alive = lambda: False
 
 
+class DevicePrefetcher:
+    """Double-buffered async host→device prefetch.
+
+    ``Trainer.fit`` previously paid the host-shard → ``device_put``
+    transfer synchronously on every step's critical path
+    (train.py ``_globalize_batch``).  This wraps the host-batch
+    iterator with ONE worker thread that runs ``transfer`` (the
+    globalize/device_put closure) for batch N+1 while the device
+    executes step N — the transfer disappears from the step loop
+    whenever it is shorter than a step.
+
+    - ``depth=2`` = classic double buffering: one batch in flight on
+      the queue plus one being transferred.  Device-side cost is
+      ``depth`` extra batches of HBM (a 1344²/b4 uint8 batch ≈ 22 MB).
+    - Ordering is preserved exactly (single producer, FIFO queue), so
+      training losses are bit-identical with the prefetcher on or off.
+    - Errors from the underlying iterator or the transfer (including
+      ``DataStarvationError``/``QuarantineOverflowError`` from the
+      loader) are re-raised in the consumer at the point of ``next()``.
+    - ``wait_ms_last``/``wait_ms_ewma`` record how long the consumer
+      blocked per batch (→ the ``data/prefetch_wait_ms`` metric);
+      ``health`` (a ``LoaderHealth``) receives the same samples so the
+      hang watchdog's report shows prefetch starvation.
+
+    ``transfer`` runs on the worker thread: jax ``device_put`` and
+    ``host_local_array_to_global_array`` are thread-safe dispatches,
+    and doing them off-thread is the entire point.
+    """
+
+    _DONE = object()
+
+    def __init__(self, batches: Iterator[Dict[str, np.ndarray]],
+                 transfer, depth: int = 2, health=None,
+                 timeout_sec: float = 120.0):
+        self._transfer = transfer
+        self._health = health
+        self._timeout = timeout_sec
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth - 1))
+        self._stop = threading.Event()
+        self._error: list = []
+        self._done = False
+        self.wait_ms_last = 0.0
+        self.wait_ms_ewma: Optional[float] = None
+        self.batches_delivered = 0
+        self._thread = threading.Thread(
+            target=self._produce, args=(iter(batches),), daemon=True,
+            name="device-prefetch")
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, it) -> None:
+        try:
+            for host_batch in it:
+                if self._stop.is_set():
+                    return
+                if not self._put(self._transfer(host_batch)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in next()
+            self._error.append(e)
+        finally:
+            self._put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:  # iterator protocol: exhausted stays exhausted
+            raise StopIteration
+        t0 = time.monotonic()
+        while True:
+            try:
+                item = self._q.get(timeout=self._timeout)
+                break
+            except queue.Empty:
+                if self._thread.is_alive():
+                    continue  # genuinely slow producer: keep waiting
+                # the worker died without its sentinel (only possible
+                # via interpreter teardown races) — diagnose, never
+                # block forever
+                from eksml_tpu.data.robust import DataStarvationError
+
+                raise DataStarvationError(
+                    "device-prefetch thread is dead with nothing "
+                    "queued and no end-of-stream sentinel") from None
+        wait_ms = (time.monotonic() - t0) * 1000.0
+        if item is self._DONE:
+            self._done = True
+            if self._error:
+                raise self._error[0]
+            raise StopIteration
+        self.wait_ms_last = wait_ms
+        self.wait_ms_ewma = (wait_ms if self.wait_ms_ewma is None
+                             else 0.8 * self.wait_ms_ewma
+                             + 0.2 * wait_ms)
+        self.batches_delivered += 1
+        if self._health is not None:
+            self._health.note_prefetch_wait(wait_ms)
+        return item
+
+    def close(self) -> None:
+        """Stop the worker and release queued device batches.  Safe to
+        call twice; always call on the consumer's exit path so an
+        exception mid-epoch cannot leak the thread or pin HBM.
+
+        Join BEFORE draining: the worker's stop-aware put exits within
+        its 0.1 s poll once the flag is set, so draining first would
+        race its final put and leave one device batch pinned."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            log.warning(
+                "device-prefetch thread still alive after close() "
+                "(blocked inside a transfer); its queued batches stay "
+                "pinned until the transfer returns")
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
 def _crop_resize_binary(mask: np.ndarray, box, out_size: int) -> np.ndarray:
     x1, y1, x2, y2 = box
     h, w = mask.shape

@@ -321,6 +321,8 @@ class LoaderHealth:
         self._build_ms_ewma: Optional[float] = None
         self._decode_ms_ewma: Optional[float] = None
         self._starvation_waits = 0
+        self._prefetch_wait_ms_ewma: Optional[float] = None
+        self._prefetch_batches = 0
 
     # -- producer side ------------------------------------------------
 
@@ -345,6 +347,17 @@ class LoaderHealth:
         with self._lock:
             self._starvation_waits += 1
 
+    def note_prefetch_wait(self, ms: float) -> None:
+        """Per-batch time the step loop blocked on the device
+        prefetcher (loader.DevicePrefetcher).  ~0 = the host→device
+        transfer fully overlaps compute; step-sized values mean the
+        input pipeline is the bottleneck."""
+        with self._lock:
+            self._prefetch_batches += 1
+            self._prefetch_wait_ms_ewma = (
+                ms if self._prefetch_wait_ms_ewma is None
+                else 0.8 * self._prefetch_wait_ms_ewma + 0.2 * ms)
+
     # -- reporting ----------------------------------------------------
 
     def scalars(self) -> Dict[str, float]:
@@ -357,6 +370,9 @@ class LoaderHealth:
             }
             if self._build_ms_ewma is not None:
                 out["batch_build_ms"] = round(self._build_ms_ewma, 2)
+            if self._prefetch_wait_ms_ewma is not None:
+                out["prefetch_wait_ms"] = round(
+                    self._prefetch_wait_ms_ewma, 2)
         if self.ledger is not None:
             out["quarantined"] = float(self.ledger.count)
             out["quarantine_frac"] = self.ledger.fraction
@@ -379,6 +395,11 @@ class LoaderHealth:
             if self._decode_ms_ewma is not None:
                 lines.append(
                     f"decode ms (ewma): {self._decode_ms_ewma:.1f}")
+            if self._prefetch_wait_ms_ewma is not None:
+                lines.append(
+                    "device-prefetch wait ms (ewma): "
+                    f"{self._prefetch_wait_ms_ewma:.1f} over "
+                    f"{self._prefetch_batches} batches")
         if self.reader is not None:
             lines.append("transient I/O recoveries: "
                          f"{self.reader.transient_recoveries}")

@@ -77,6 +77,10 @@ def max_fg_proposals(batch_per_im: int, fg_ratio: float) -> int:
     return max(1, n) if fg_ratio > 0 else 0
 
 
+# named_scope contract: these scope names are what the profiling
+# attribution maps to components (eksml_tpu/profiling SCOPE_RULES) —
+# rename both sides together or the fusion falls into "other"
+@jax.named_scope("sampling")
 def sample_proposal_targets(
     proposals: jnp.ndarray,       # [P, 4]
     proposal_scores: jnp.ndarray, # [P] (-inf padding)
@@ -134,6 +138,7 @@ def sample_proposal_targets(
     return rois, labels, matched_sel, is_fg & take, take
 
 
+@jax.named_scope("frcnn_loss")
 def box_head_losses(logits, deltas, rois, roi_labels, matched_gt, gt_boxes,
                     fg_mask, valid_mask, reg_weights):
     """Softmax CE over sampled proposals + smooth-L1 on fg boxes,
@@ -153,6 +158,7 @@ def box_head_losses(logits, deltas, rois, roi_labels, matched_gt, gt_boxes,
     return cls_loss, box_loss
 
 
+@jax.named_scope("mask_loss")
 def mask_head_loss(mask_logits, roi_labels, mask_targets, fg_mask):
     """Per-fg-ROI BCE on the GT-class mask channel.
 

@@ -172,9 +172,10 @@ class MaskRCNN(nn.Module):
         normalized (legacy path)."""
         x = images
         if x.dtype == jnp.uint8:
-            mean = jnp.asarray(self.pixel_mean, jnp.float32)
-            std = jnp.asarray(self.pixel_std, jnp.float32)
-            x = (x.astype(jnp.float32) - mean) / std
+            with jax.named_scope("input_norm"):
+                mean = jnp.asarray(self.pixel_mean, jnp.float32)
+                std = jnp.asarray(self.pixel_std, jnp.float32)
+                x = (x.astype(jnp.float32) - mean) / std
         x = x.astype(self.compute_dtype)
         c_feats = self.backbone(x)
         return self.fpn(c_feats)  # P2..P6
@@ -371,6 +372,7 @@ class MaskRCNN(nn.Module):
             )(boxes, deltas.reshape(b, p, 4), image_hw)
         return boxes, probs_sum / len(self.cascade_heads)
 
+    @jax.named_scope("mask_targets")
     def _mask_targets(self, rois, matched_gt, gt_boxes, gt_masks):
         """Resample bbox-cropped GT masks to per-ROI mask targets.
 

@@ -53,6 +53,7 @@ class RPNHead(nn.Module):
         return logits, deltas
 
 
+@jax.named_scope("matching")
 def match_anchors(anchors: jnp.ndarray, gt_boxes: jnp.ndarray,
                   gt_valid: jnp.ndarray, pos_thresh: float,
                   neg_thresh: float,
@@ -99,6 +100,7 @@ def match_anchors(anchors: jnp.ndarray, gt_boxes: jnp.ndarray,
     return labels, matched_gt
 
 
+@jax.named_scope("sampling")
 def sample_anchors(labels: jnp.ndarray, rng: jax.Array, batch_per_im: int,
                    fg_ratio: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fixed-size fg/bg anchor subsample for the loss; see
@@ -115,6 +117,7 @@ def sample_anchors(labels: jnp.ndarray, rng: jax.Array, batch_per_im: int,
     return fg_mask, bg_mask
 
 
+@jax.named_scope("rpn_nms")
 def generate_proposals(
     per_level_logits: Sequence[jnp.ndarray],   # [(A_l,), ...] one image
     per_level_deltas: Sequence[jnp.ndarray],   # [(A_l, 4), ...]
@@ -161,6 +164,7 @@ def generate_proposals(
     return boxes[top_idx], top_scores
 
 
+@jax.named_scope("rpn_loss")
 def rpn_losses(logits: jnp.ndarray, deltas: jnp.ndarray,
                anchors: jnp.ndarray, labels: jnp.ndarray,
                matched_gt: jnp.ndarray, gt_boxes: jnp.ndarray,

@@ -27,6 +27,9 @@ import jax.numpy as jnp
 from eksml_tpu.ops.boxes import pairwise_iou
 
 
+# "nms" scope → the rpn-nms attribution component (eksml_tpu/profiling
+# SCOPE_RULES); keeps NMS fusions nameable in profiles
+@jax.named_scope("nms")
 def nms_mask(boxes: jnp.ndarray, scores: jnp.ndarray,
              iou_threshold: float, tile: int | None = None) -> jnp.ndarray:
     """Greedy NMS keep-mask for boxes ``[K, 4]`` (any order).
@@ -155,6 +158,7 @@ def batched_nms(boxes: jnp.ndarray, scores: jnp.ndarray,
     return fn(boxes, scores)
 
 
+@jax.named_scope("nms")
 def class_aware_nms(boxes, scores, iou_threshold: float, max_outputs: int,
                     class_ids=None, class_offset_scale: float = None):
     """Per-class NMS via the coordinate-offset trick: shift each class's

@@ -102,6 +102,30 @@ def ensure_scoped_vmem_limit(kib: int | None = None) -> None:
         f"{flags} --xla_tpu_scoped_vmem_limit_kib={kib}").strip()
 
 
+_vmem_limit_logged = False
+
+
+def _log_vmem_limit_once() -> None:
+    """One line at the FIRST kernel build naming the effective
+    scoped-vmem limit.  EKSML_SCOPED_VMEM_KIB must be set before that
+    first compile: the limit is baked into the jitted program AND keyed
+    into the persistent compile cache, so changing the env afterwards
+    silently does not apply (ADVICE r5 #2) — this log is the evidence
+    of which value actually governs the run."""
+    global _vmem_limit_logged
+    if _vmem_limit_logged:
+        return
+    _vmem_limit_logged = True
+    kib = _scoped_vmem_kib()
+    src = ("EKSML_SCOPED_VMEM_KIB override"
+           if "EKSML_SCOPED_VMEM_KIB" in os.environ else "default")
+    log.info(
+        "Pallas ROIAlign: effective scoped-vmem stack limit %d KiB "
+        "(%s).  NOTE: set EKSML_SCOPED_VMEM_KIB before the first "
+        "compile — jit + the persistent compile cache mean a later "
+        "change silently does not apply.", kib, src)
+
+
 def _compiler_params(extra_bytes: int = 0):
     """Per-kernel Mosaic params carrying the scoped-vmem stack limit
     IN the compiled module (see ensure_scoped_vmem_limit: the env flag
@@ -111,6 +135,7 @@ def _compiler_params(extra_bytes: int = 0):
     extra scratch (the bwd overlap pipeline) declare it here."""
     from jax.experimental.pallas import tpu as pltpu
 
+    _log_vmem_limit_once()
     return pltpu.CompilerParams(
         vmem_limit_bytes=_scoped_vmem_kib() * 1024 + extra_bytes)
 

@@ -215,6 +215,9 @@ def batched_multilevel_roi_align(feats, rois, strides, out_size,
     return fn(tuple(feats), rois, levels)
 
 
+# "roi_align" scope → roi-fwd / roi-bwd (transpose context) in the
+# profiling attribution (eksml_tpu/profiling SCOPE_RULES)
+@jax.named_scope("roi_align")
 def dispatch_roi_align(feats, rois, strides, out_size,
                        sampling_ratio: int = 2, min_level: int = 2):
     """Backend dispatch: the Pallas kernel on real TPU (assigned-level

@@ -133,6 +133,19 @@ def make_optimizer(cfg):
     return optax.chain(*chain), sched
 
 
+def cast_params_for_storage(params, param_dtype: str):
+    """TRAIN.PARAM_DTYPE storage cast (the 1344/b8 memory plan): f32
+    leaves → bf16; everything else keeps its dtype.  ONE definition
+    shared by Trainer.init_state and bench.py, so the bench A/B always
+    measures the same memory plan production training uses.  Cast
+    BEFORE tx.init so the momentum tree follows."""
+    if param_dtype != "bfloat16":
+        return params
+    return jax.tree.map(
+        lambda x: (x.astype(jnp.bfloat16)
+                   if x.dtype == jnp.float32 else x), params)
+
+
 class Trainer:
     """Owns mesh, model, state, loop. One instance per host process."""
 
@@ -195,6 +208,8 @@ class Trainer:
             out_shardings=self._state_sharding)(rng, sample)
         if self.cfg.BACKBONE.WEIGHTS:
             params = self._load_backbone(params)
+        params = cast_params_for_storage(
+            params, getattr(self.cfg.TRAIN, "PARAM_DTYPE", "float32"))
         opt_state = self.tx.init(params)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=opt_state, rng=rng)
@@ -240,12 +255,15 @@ class Trainer:
             return losses["total_loss"], losses
 
         grads, losses = jax.grad(loss_fn, has_aux=True)(state.params)
-        updates, new_opt = self.tx.update(grads, state.opt_state,
-                                          state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        metrics = dict(losses)
-        metrics["learning_rate"] = self.sched(state.step)
-        metrics["grad_norm"] = optax.global_norm(grads)
+        # scope → the "optimizer" attribution component
+        # (eksml_tpu/profiling SCOPE_RULES)
+        with jax.named_scope("optimizer"):
+            updates, new_opt = self.tx.update(grads, state.opt_state,
+                                              state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = dict(losses)
+            metrics["learning_rate"] = self.sched(state.step)
+            metrics["grad_norm"] = optax.global_norm(grads)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt)
         return new_state, metrics
@@ -348,12 +366,29 @@ class Trainer:
                                       max_rollbacks=res.MAX_ROLLBACKS)
         nan_injected = False
 
+        # TRAIN.PREFETCH_TO_DEVICE: the next batch's host-shard →
+        # device transfer runs on a worker thread while the device
+        # executes the current step, instead of blocking here every
+        # step.  Batch order is unchanged → losses bit-identical
+        # (pinned in tests/test_prefetch.py); residual blocking is the
+        # data/prefetch_wait_ms metric.
+        prefetcher = None
+        source = batches
+        if getattr(cfg.TRAIN, "PREFETCH_TO_DEVICE", False):
+            from eksml_tpu.data.loader import DevicePrefetcher
+
+            prefetcher = DevicePrefetcher(batches,
+                                          self._globalize_batch,
+                                          health=data_health)
+            source = prefetcher
+
         step = start_step
         try:
-            for batch in batches:
+            for batch in source:
                 if watchdog:
                     watchdog.beat("globalize_batch", step)
-                device_batch = self._globalize_batch(batch)
+                device_batch = (batch if prefetcher is not None
+                                else self._globalize_batch(batch))
                 if state is None:
                     state, step = self.restore_or_init(device_batch)
                     if step >= total_steps:
@@ -430,6 +465,11 @@ class Trainer:
                             {f"data/{k}": float(v) for k, v
                              in data_health.scalars().items()
                              if isinstance(v, (int, float))})
+                    elif prefetcher is not None:
+                        # no LoaderHealth surface (direct fit callers):
+                        # still emit the prefetch wait
+                        metrics["data/prefetch_wait_ms"] = round(
+                            prefetcher.wait_ms_ewma or 0.0, 2)
                     dt = time.time() - t_last
                     t_last = time.time()
                     # normalize by the steps actually covered since the
@@ -506,6 +546,11 @@ class Trainer:
                 watchdog.stop()
             if preempt is not None:
                 preempt.uninstall()
+            if prefetcher is not None:
+                # stop the transfer thread and drop its queued device
+                # batches — an exception mid-loop must not leak the
+                # thread or pin prefetched HBM
+                prefetcher.close()
             # always drain the async checkpoint thread and buffered
             # metrics — an exception mid-loop must not abandon an
             # in-flight save or lose the last metric rows.  A drain

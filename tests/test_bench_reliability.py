@@ -169,20 +169,21 @@ def test_ladder_banks_each_rung_and_promotes_headline(monkeypatch,
     monkeypatch.setattr(bench_mod.os, "_exit", lambda code: None)
     bench_mod.main(["--steps", "1"])
     assert seen == [(256, (), 1), (512, (), 1), (1344, (832, 1344), 4),
-                    (1344, (), 4)]
+                    (1344, (), 4), (1344, (), 8)]
     for rung in ("micro_256_b1_fwd", "512_b1", "832x1344_b4",
-                 "1344_b4"):
+                 "1344_b4", "1344_b8_remat"):
         banked = json.load(open(tmp_path / f"bench_rung_{rung}.json"))
         assert banked["value"] > 0 and "banked_at" in banked
     out_lines = [l for l in capsys.readouterr().out.splitlines()
                  if l.strip().startswith("{")]
     assert len(out_lines) == 1, out_lines
     diag = json.loads(out_lines[0])
-    assert diag["operating_point"] == "1344_b4"
+    assert diag["operating_point"] == "1344_b8_remat"
     assert diag["headline_point"] is True
-    assert diag["value"] == 40.0
+    assert diag["value"] == 50.0
     assert [r["rung"] for r in diag["rungs"]] == [
-        "micro_256_b1_fwd", "512_b1", "832x1344_b4", "1344_b4"]
+        "micro_256_b1_fwd", "512_b1", "832x1344_b4", "1344_b4",
+        "1344_b8_remat"]
 
 
 def test_ladder_partial_failure_keeps_cheap_rung(monkeypatch,
@@ -268,6 +269,7 @@ def test_ladder_carries_remat_to_larger_rungs(monkeypatch, tmp_path,
         (1344, True, False),    # bucket rung: OOM ...
         (1344, True, True),     # ... retried with remat
         (1344, False, True),    # headline STARTS with remat
+        (1344, False, True),    # b8 memory-plan rung forces remat
     ]
     capsys.readouterr()
 

@@ -1,0 +1,103 @@
+"""Golden rendered chart manifests (VERDICT r5 missing #4).
+
+No helm binary exists in this environment, so the charts' rendering
+contract is enforced by the in-house resolver (tools/render_charts.py)
+plus these committed goldens: any template/values change must show up
+as a reviewable manifest diff, the property ``helm template`` gives
+real clusters' CI.
+"""
+
+import os
+import sys
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import render_charts
+
+
+GOLDEN_FILES = sorted(
+    f"{os.path.basename(chart)}__{name}.yaml"
+    for chart in render_charts.CHARTS
+    for name in ("maskrcnn",) + render_charts.SUBCHARTS)
+
+
+def test_rendered_manifests_match_committed_goldens():
+    rendered = render_charts.render_all()
+    assert sorted(rendered) == GOLDEN_FILES
+    for name, text in rendered.items():
+        path = os.path.join(REPO, render_charts.GOLDEN_DIR, name)
+        assert os.path.exists(path), (
+            f"missing golden {name} — run "
+            "`python tools/render_charts.py --update`")
+        with open(path) as f:
+            committed = f.read()
+        assert text == committed, (
+            f"{name} drifted from its committed golden — review the "
+            "template/values change, then run "
+            "`python tools/render_charts.py --update`")
+
+
+@pytest.mark.parametrize("name", GOLDEN_FILES)
+def test_goldens_are_valid_k8s_documents(name):
+    with open(os.path.join(REPO, render_charts.GOLDEN_DIR, name)) as f:
+        docs = [d for d in yaml.safe_load_all(f.read()) if d]
+    assert docs, name
+    for d in docs:
+        assert "kind" in d and "apiVersion" in d, (name, d)
+
+
+def test_golden_jobset_contract():
+    """The bugs the string checks could not see: the rendered JobSet's
+    numeric/structural fields are coherent end-to-end."""
+    with open(os.path.join(REPO, render_charts.GOLDEN_DIR,
+                           "maskrcnn__maskrcnn.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f.read()) if d]
+    js = next(d for d in docs if d["kind"] == "JobSet")
+    vals = yaml.safe_load(open(os.path.join(
+        REPO, "charts/maskrcnn/values.yaml")))["maskrcnn"]
+    job = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+    hosts = vals["chips"] // vals["chips_per_host"]
+    assert job["parallelism"] == hosts
+    assert job["completions"] == hosts
+    pod = job["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == \
+        vals["chips_per_host"]
+    # topology label is the physical grid, not a chip count
+    sel = pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+    x, y = map(int, sel.split("x"))
+    assert x * y == vals["chips"]
+    # the rendered argv carries the pinned run id
+    argv = c["command"]
+    logdir = argv[argv.index("--logdir") + 1]
+    assert render_charts.TIMESTAMP in logdir
+    # exit-code contract rendered concretely
+    rules = job["podFailurePolicy"]["rules"]
+    assert rules[0]["onExitCodes"]["values"] == \
+        [vals["preempt_exit_code"]]
+
+
+def test_engine_fail_surfaces_values_errors():
+    """The helpers' render-time `fail` guards must actually fire in the
+    resolver (chips != topology x slices is the bug class the r2 '32x1'
+    label shipped)."""
+    values = yaml.safe_load(open(os.path.join(
+        REPO, "charts/maskrcnn/values.yaml")))
+    values["maskrcnn"]["chips"] = 12  # not topology(32) x slices(1)
+    values["maskrcnn"]["image"] = "x"
+    helpers_src = open(os.path.join(
+        REPO, "charts/maskrcnn/templates/_helpers.tpl")).read()
+    nodes, _, _ = render_charts._parse(
+        render_charts._tokenize(helpers_src))
+    helpers = {n[1]: n[2] for n in nodes if n[0] == "define"}
+    eng = render_charts.Engine(
+        {"Values": values, "Release": {"Name": "x"}}, helpers)
+    tpl = open(os.path.join(
+        REPO, "charts/maskrcnn/templates/maskrcnn.yaml")).read()
+    with pytest.raises(render_charts.RenderError,
+                       match="must equal topology chips"):
+        eng.render(tpl)

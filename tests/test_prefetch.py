@@ -1,0 +1,277 @@
+"""DevicePrefetcher: async host→device transfer off the step path.
+
+Covers the ISSUE-3 prefetch acceptance: overlap actually occurs, batch
+order/content (and therefore training losses) are unchanged, shutdown
+is clean, loader failures — including DataStarvationError — still
+surface, and the data/prefetch_wait_ms metric reaches both the metric
+stream and the LoaderHealth/watchdog report surface.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from eksml_tpu.data.loader import DevicePrefetcher
+from eksml_tpu.data.robust import DataStarvationError, LoaderHealth
+
+
+def _batches(n):
+    for i in range(n):
+        yield {"i": np.full((2, 2), i), "j": np.full((3,), i * 10)}
+
+
+# ---- unit ------------------------------------------------------------
+
+
+def test_order_and_content_preserved():
+    """The bit-identity property reduces to this: the prefetcher hands
+    the SAME batches in the SAME order as direct iteration, so the
+    jitted step sees identical inputs with prefetch on or off."""
+    direct = list(_batches(5))
+    seen = list(DevicePrefetcher(_batches(5), transfer=lambda b: b))
+    assert len(seen) == 5
+    for d, s in zip(direct, seen):
+        assert sorted(d) == sorted(s)
+        for k in d:
+            np.testing.assert_array_equal(d[k], s[k])
+
+
+def test_transfer_overlaps_consumption():
+    """While the consumer holds batch 0 (the 'device is computing'
+    phase), the worker must already be transferring batch 1 — the
+    overlap that removes the transfer from the step critical path."""
+    transferred = []
+    done = threading.Event()
+
+    def transfer(b):
+        transferred.append(int(b["i"][0, 0]))
+        if len(transferred) >= 2:
+            done.set()
+        return b
+
+    pf = DevicePrefetcher(_batches(4), transfer, depth=2)
+    try:
+        first = next(pf)
+        assert int(first["i"][0, 0]) == 0
+        # no further next() call: batch 1's transfer must happen anyway
+        assert done.wait(timeout=5.0), (
+            "prefetcher did not transfer ahead of consumption")
+        assert transferred[:2] == [0, 1]
+    finally:
+        pf.close()
+
+
+def test_clean_shutdown_mid_stream():
+    def endless():
+        i = 0
+        while True:
+            yield {"i": np.full((1,), i)}
+            i += 1
+
+    pf = DevicePrefetcher(endless(), transfer=lambda b: b)
+    next(pf)
+    pf.close()
+    assert not pf._thread.is_alive()
+    pf.close()  # idempotent
+
+
+def test_loader_error_propagates():
+    def broken():
+        yield {"i": np.zeros(1)}
+        raise DataStarvationError("producer thread is dead")
+
+    pf = DevicePrefetcher(broken(), transfer=lambda b: b)
+    next(pf)
+    with pytest.raises(DataStarvationError, match="producer"):
+        next(pf)
+    pf.close()
+
+
+def test_transfer_error_propagates():
+    def transfer(b):
+        raise RuntimeError("device_put exploded")
+
+    pf = DevicePrefetcher(_batches(2), transfer)
+    with pytest.raises(RuntimeError, match="device_put"):
+        next(pf)
+    pf.close()
+
+
+def test_health_surface_records_wait():
+    health = LoaderHealth()
+    pf = DevicePrefetcher(_batches(3), transfer=lambda b: b,
+                          health=health)
+    list(pf)
+    scalars = health.scalars()
+    assert "prefetch_wait_ms" in scalars
+    assert scalars["prefetch_wait_ms"] >= 0.0
+    assert "device-prefetch wait ms" in health.report()
+    assert pf.batches_delivered == 3
+    assert pf.wait_ms_ewma is not None
+
+
+def test_wait_metric_reflects_slow_producer():
+    def slow():
+        for i in range(2):
+            time.sleep(0.15)
+            yield {"i": np.full((1,), i)}
+
+    pf = DevicePrefetcher(slow(), transfer=lambda b: b)
+    list(pf)
+    assert pf.wait_ms_last >= 50.0  # consumer demonstrably blocked
+    pf.close()
+
+
+# ---- fit-level: bit identity + metric emission ----------------------
+
+
+def _tiny(cfg, logdir):
+    cfg.PREPROC.MAX_SIZE = 128
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (128, 128)
+    cfg.DATA.MAX_GT_BOXES = 8
+    cfg.DATA.SYNTHETIC = True
+    cfg.DATA.NUM_WORKERS = 0
+    cfg.RPN.TRAIN_PRE_NMS_TOPK = 128
+    cfg.RPN.TRAIN_POST_NMS_TOPK = 64
+    cfg.FRCNN.BATCH_PER_IM = 32
+    cfg.TRAIN.STEPS_PER_EPOCH = 4
+    cfg.TRAIN.MAX_EPOCHS = 1
+    cfg.TRAIN.CHECKPOINT_PERIOD = 1
+    cfg.TRAIN.LOG_PERIOD = 1
+    cfg.TRAIN.LOGDIR = logdir
+    cfg.TPU.MESH_SHAPE = (1, 1)
+    return cfg
+
+
+def _fit_params(cfg, steps=2):
+    from eksml_tpu.data import DetectionLoader, SyntheticDataset
+    from eksml_tpu.train import Trainer
+
+    ds = SyntheticDataset(num_images=4, height=128, width=128,
+                          num_classes=cfg.DATA.NUM_CLASSES)
+    loader = DetectionLoader(ds.records(), cfg, batch_size=1,
+                             with_masks=True, gt_mask_size=28, seed=0)
+    trainer = Trainer(cfg, cfg.TRAIN.LOGDIR)
+    state = trainer.fit(loader.batches(None), total_steps=steps)
+    trainer.ckpt.close()
+    return state
+
+
+@pytest.mark.slow
+def test_fit_losses_bit_identical_with_prefetch(fresh_config, tmp_path):
+    """Two steps of the real trainer, prefetch ON vs OFF: identical
+    batch stream → bit-identical final params (the fit-level half of
+    the dryrun parity acceptance)."""
+    cfg = _tiny(fresh_config, str(tmp_path / "on"))
+    cfg.TRAIN.PREFETCH_TO_DEVICE = True
+    cfg.freeze()
+    state_on = _fit_params(cfg)
+
+    cfg.freeze(False)
+    cfg.TRAIN.PREFETCH_TO_DEVICE = False
+    cfg.TRAIN.LOGDIR = str(tmp_path / "off")
+    cfg.freeze()
+    state_off = _fit_params(cfg)
+
+    import jax
+
+    leaves_on = jax.tree.leaves(state_on.params)
+    leaves_off = jax.tree.leaves(state_off.params)
+    assert len(leaves_on) == len(leaves_off)
+    for a, b in zip(leaves_on, leaves_off):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the metric landed in the run's stream (prefetch run only)
+    rows = [json.loads(l) for l in
+            open(os.path.join(str(tmp_path / "on"), "metrics.jsonl"))]
+    assert any("data/prefetch_wait_ms" in r for r in rows), rows[:2]
+
+
+@pytest.mark.slow
+def test_fit_remat_parity_and_bf16_params(fresh_config, tmp_path):
+    """The memory-plan knobs: REMAT recomputes the same math (loss
+    parity to float tolerance); PARAM_DTYPE=bfloat16 stores params +
+    momentum in bf16 and still trains a finite loss."""
+    cfg = _tiny(fresh_config, str(tmp_path / "base"))
+    cfg.freeze()
+    base = _fit_params(cfg)
+
+    cfg.freeze(False)
+    cfg.TRAIN.REMAT = True
+    cfg.TRAIN.LOGDIR = str(tmp_path / "remat")
+    cfg.freeze()
+    remat = _fit_params(cfg)
+
+    import jax
+
+    for a, b in zip(jax.tree.leaves(base.params),
+                    jax.tree.leaves(remat.params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-4, atol=2e-5)
+
+    cfg.freeze(False)
+    cfg.TRAIN.REMAT = False
+    cfg.TRAIN.PARAM_DTYPE = "bfloat16"
+    cfg.TRAIN.LOGDIR = str(tmp_path / "bf16")
+    cfg.freeze()
+    bf16 = _fit_params(cfg)
+    import jax.numpy as jnp
+
+    kinds = {l.dtype for l in jax.tree.leaves(bf16.params)}
+    assert kinds == {jnp.bfloat16.dtype if hasattr(jnp.bfloat16, "dtype")
+                     else np.dtype("bfloat16")}, kinds
+    rows = [json.loads(l) for l in
+            open(os.path.join(str(tmp_path / "bf16"), "metrics.jsonl"))]
+    last = [r for r in rows if "total_loss" in r][-1]
+    assert np.isfinite(last["total_loss"])
+    base_rows = [json.loads(l) for l in
+                 open(os.path.join(str(tmp_path / "base"),
+                                   "metrics.jsonl"))]
+    base_last = [r for r in base_rows if "total_loss" in r][-1]
+    # bf16 storage rounds the weights (~2^-8 relative): loss agrees to
+    # bf16 tolerance, not bitwise
+    np.testing.assert_allclose(last["total_loss"],
+                               base_last["total_loss"], rtol=0.1)
+
+
+def test_param_dtype_bfloat16_state(fresh_config, tmp_path):
+    """init_state under TRAIN.PARAM_DTYPE=bfloat16: params AND the
+    optimizer's momentum tree store in bf16 (the ~180 MB saving at
+    R50-FPN scale); the step counter stays integer."""
+    from eksml_tpu.data import SyntheticDataset
+    from eksml_tpu.train import Trainer
+
+    cfg = _tiny(fresh_config, str(tmp_path / "run"))
+    cfg.TRAIN.PARAM_DTYPE = "bfloat16"
+    cfg.freeze()
+    ds = SyntheticDataset(num_images=2, height=128, width=128,
+                          num_classes=cfg.DATA.NUM_CLASSES)
+    from eksml_tpu.data import DetectionLoader
+
+    loader = DetectionLoader(ds.records(), cfg, batch_size=1,
+                             with_masks=True, gt_mask_size=28)
+    trainer = Trainer(cfg, cfg.TRAIN.LOGDIR)
+    batch = next(iter(loader.batches(1)))
+    batch = {k: v for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+    state = trainer.init_state(batch)
+    trainer.ckpt.close()
+
+    import jax
+
+    float_leaves = [l for l in jax.tree.leaves(state.params)
+                    if np.issubdtype(np.asarray(l).dtype, np.floating)
+                    or str(np.asarray(l).dtype) == "bfloat16"]
+    assert float_leaves
+    assert all(str(np.asarray(l).dtype) == "bfloat16"
+               for l in float_leaves)
+    mom_dtypes = {str(np.asarray(l).dtype)
+                  for l in jax.tree.leaves(state.opt_state)
+                  if hasattr(l, "dtype")
+                  and np.asarray(l).dtype.kind in "fV"}
+    assert mom_dtypes <= {"bfloat16"}, mom_dtypes

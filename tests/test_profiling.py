@@ -1,0 +1,264 @@
+"""eksml_tpu/profiling: HLO cost attribution by model component.
+
+VERDICT r5 weak #3 acceptance: on a CPU-compiled train step, the
+component table must attribute >=70% of modeled cost to NAMED
+components (<=30% "other"), and every top-10 instruction must resolve
+— the property whose absence made round 5's trace unreadable
+("other" 86.78%, ops named "5"/"2"/"23").
+
+Also covers the fast CPU smoke of tools/op_microbench.py (tier-1, so
+the banked-artifact harness cannot bit-rot before its next hardware
+window).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eksml_tpu.profiling import (HloAttribution, attribution_map,
+                                 component_table, resolve_component)
+
+# ---- unit: scope → component resolution -----------------------------
+
+
+def test_resolve_component_scopes():
+    fwd = "jit(train_step)/jit(main)/jvp(MaskRCNN)/backbone/group0/conv"
+    bwd = ("jit(train_step)/jit(main)/transpose(jvp(MaskRCNN))/"
+           "backbone/group0/conv")
+    assert resolve_component(fwd) == "backbone"
+    assert resolve_component(bwd) == "backbone-bwd"
+    roi = "jit(x)/jvp(MaskRCNN)/roi_align/gather"
+    roib = "jit(x)/transpose(jvp(MaskRCNN))/roi_align/scatter"
+    assert resolve_component(roi) == "roi-fwd"
+    assert resolve_component(roib) == "roi-bwd"
+    # transform-wrapped scopes (vmap) still resolve
+    nms = ("jit(t)/jvp(MaskRCNN)/MaskRCNN._proposals/vmap(rpn_nms)/"
+           "vmap(nms)/while/body/sub")
+    assert resolve_component(nms) == "rpn-nms"
+    # the ROOT class transform label must NOT hit the mask HEAD rule
+    root = "jit(t)/transpose(jvp(MaskRCNN))/fpn/posthoc_2/conv"
+    assert resolve_component(root) == "fpn-conv-bwd"
+    assert resolve_component("jit(t)/jvp(MaskRCNN)/maskrcnn/fcn0/conv") \
+        == "mask-head"
+    assert resolve_component("jit(t)/optimizer/add") == "optimizer"
+    # collectives resolve by OPCODE (XLA inserts them scope-less)
+    assert resolve_component("", opcode="all-reduce") == "allreduce"
+    assert resolve_component("unknown/thing") is None
+
+
+# ---- unit: parser on a hand-rolled module ---------------------------
+
+HLO_FIXTURE = """\
+HloModule jit_step, entry_computation_layout={()->f32[8]{0}}
+
+%fused_computation (param_0.1: f32[64,64]) -> f32[64,64] {
+  %param_0.1 = f32[64,64]{1,0} parameter(0)
+  ROOT %multiply.1 = f32[64,64]{1,0} multiply(f32[64,64]{1,0} %param_0.1, f32[64,64]{1,0} %param_0.1), metadata={op_name="jit(step)/jvp(MaskRCNN)/backbone/group0/mul" source_file="x.py" source_line=1}
+}
+
+ENTRY %main.9 (Arg_0.1: f32[64,64]) -> f32[8] {
+  %Arg_0.1 = f32[64,64]{1,0} parameter(0)
+  %fusion.5 = f32[64,64]{1,0} fusion(f32[64,64]{1,0} %Arg_0.1), kind=kLoop, calls=%fused_computation
+  %convolution.2 = f32[64,64]{1,0} convolution(f32[64,64]{1,0} %fusion.5, f32[64,64]{1,0} %Arg_0.1), window={size=1x1}, dim_labels=bf01_oi01->bf01, metadata={op_name="jit(step)/transpose(jvp(MaskRCNN))/fpn/lateral_2/conv_general_dilated"}
+  %all-reduce.3 = f32[64,64]{1,0} all-reduce(f32[64,64]{1,0} %convolution.2), replica_groups={}, to_apply=%fused_computation
+  %bitcast.7 = f32[8]{0} bitcast(f32[64,64]{1,0} %all-reduce.3)
+  ROOT %copy.8 = f32[8]{0} copy(f32[8]{0} %bitcast.7)
+}
+"""
+
+
+def test_parser_and_fusion_resolution():
+    attr = HloAttribution(HLO_FIXTURE)
+    amap = attr.attribution_map()
+    # the fusion has no own metadata: resolved by its body's votes
+    assert amap["fusion.5"] == "backbone"
+    assert amap["convolution.2"] == "fpn-conv-bwd"
+    assert amap["all-reduce.3"] == "allreduce"
+    table = attr.component_table()
+    assert set(table["component_pct"]) >= {"backbone", "fpn-conv-bwd",
+                                           "allreduce"}
+    assert table["other_pct"] < 100.0
+
+
+def test_metadata_free_instruction_inherits_from_neighbors():
+    # the neighbor-inheritance pass: %copy.8 / %bitcast.7 carry no
+    # metadata; they take their producer chain's component instead of
+    # landing in "other"
+    amap = attribution_map(HLO_FIXTURE)
+    assert amap["copy.8"] == "allreduce"
+
+
+# ---- the acceptance fixture: CPU-compiled train step ----------------
+
+
+def _compiled_train_step_hlo(cfg, image_size, batch_size):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from eksml_tpu.data.loader import make_synthetic_batch
+    from eksml_tpu.models import MaskRCNN
+    from eksml_tpu.train import make_optimizer
+
+    model = MaskRCNN.from_config(cfg)
+    batch = make_synthetic_batch(cfg, batch_size=batch_size,
+                                 image_size=image_size)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+    rng = jax.random.PRNGKey(0)
+    params = jax.jit(
+        lambda r, b: model.init(r, b, r)["params"])(rng, batch)
+    tx, _ = make_optimizer(cfg)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, batch, rng):
+        def loss_fn(p):
+            losses = model.apply({"params": p}, batch, rng)
+            return losses["total_loss"], losses
+
+        grads, losses = jax.grad(loss_fn, has_aux=True)(params)
+        with jax.named_scope("optimizer"):
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_opt,
+                    losses["total_loss"])
+
+    return jax.jit(train_step).lower(
+        params, opt_state, batch, rng).compile().as_text()
+
+
+def _assert_attribution_quality(hlo, max_other_pct=30.0):
+    attr = HloAttribution(hlo)
+    table = attr.component_table(top_n=10)
+    assert table["other_pct"] <= max_other_pct, table["component_pct"]
+    # every top-10 fusion/instruction resolves to a NAMED component
+    assert len(table["top_instructions"]) >= 5
+    for row in table["top_instructions"]:
+        assert row["component"] != "other", row
+    # the components the step-time question hinges on all appear
+    comps = set(table["component_pct"])
+    for needed in ("backbone", "optimizer", "roi-fwd", "roi-bwd",
+                   "rpn-nms"):
+        assert needed in comps, (needed, sorted(comps))
+    return table
+
+
+def test_train_step_attribution_tiny(fresh_config):
+    """Tier-1 rung: the smoke-geometry train step (same program
+    structure as the flagship point, shrunk widths/canvas) must
+    attribute >=70% of modeled cost and resolve its whole top-10."""
+    from eksml_tpu.config import SMOKE_OVERRIDES, finalize_configs
+
+    cfg = fresh_config
+    cfg.update_args(SMOKE_OVERRIDES)
+    cfg.TRAIN.BATCH_SIZE_PER_CHIP = 1
+    finalize_configs(is_training=True)
+    hlo = _compiled_train_step_hlo(cfg, image_size=128, batch_size=1)
+    _assert_attribution_quality(hlo)
+
+
+@pytest.mark.slow
+def test_train_step_attribution_1344_b4(fresh_config):
+    """The acceptance operating point: a 1344/b4 train step compiled on
+    CPU (shrunk channel widths keep the compile tractable; the CANVAS
+    and batch — what decides the fusion structure the flagship profile
+    shows — are the real 1344/b4)."""
+    from eksml_tpu.config import SMOKE_OVERRIDES, finalize_configs
+
+    cfg = fresh_config
+    cfg.update_args(SMOKE_OVERRIDES)
+    cfg.PREPROC.MAX_SIZE = 1344
+    cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (1344, 1344)
+    cfg.TRAIN.BATCH_SIZE_PER_CHIP = 4
+    finalize_configs(is_training=True)
+    hlo = _compiled_train_step_hlo(cfg, image_size=1344, batch_size=4)
+    table = _assert_attribution_quality(hlo)
+    # at the flagship canvas the conv trunk must dominate modeled cost
+    pct = table["component_pct"]
+    conv = sum(pct.get(k, 0.0) for k in
+               ("backbone", "backbone-bwd", "fpn-conv", "fpn-conv-bwd",
+                "rpn-head", "rpn-head-bwd"))
+    assert conv > 20.0, pct
+
+
+# ---- trace_summary integration --------------------------------------
+
+
+def test_trace_summary_resolves_event_names(tmp_path):
+    """Event names as the r5 trace recorded them — bare numbers,
+    %-prefixed, exact — must resolve through the attribution map."""
+    from tools.trace_summary import load_component_map, summarize
+
+    art = tmp_path / "attribution.json"
+    art.write_text(json.dumps({"map": {
+        "fusion.5": "rpn-nms", "fusion.23": "roi-bwd",
+        "convolution.2": "backbone"}}))
+    cmap = load_component_map(str(art))
+    # alias: the bare numeric suffix resolves when unambiguous
+    assert cmap["5"] == "rpn-nms"
+
+    trace = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 1, "name": "5", "dur": 700.0},
+        {"ph": "X", "pid": 1, "name": "%fusion.23", "dur": 200.0},
+        {"ph": "X", "pid": 1, "name": "convolution.2", "dur": 50.0},
+        {"ph": "X", "pid": 1, "name": "mystery.9", "dur": 50.0},
+    ]}
+    d = tmp_path / "plugins" / "profile" / "run"
+    d.mkdir(parents=True)
+    (d / "host.trace.json").write_text(json.dumps(trace))
+    out = summarize(str(tmp_path), component_map=cmap)
+    assert out["component_pct"]["rpn-nms"] == 70.0
+    assert out["component_pct"]["roi-bwd"] == 20.0
+    assert out["component_pct"]["backbone"] == 5.0
+    assert out["component_other_pct"] == 5.0
+    top = {r["name"]: r.get("component") for r in out["top_ops"]}
+    assert top["5"] == "rpn-nms"
+    assert top["mystery.9"] == "other"
+
+
+def test_trace_summary_numeric_alias_ambiguity(tmp_path):
+    """Two instructions sharing a numeric suffix must NOT alias."""
+    from tools.trace_summary import load_component_map
+
+    art = tmp_path / "a.json"
+    art.write_text(json.dumps({"map": {
+        "fusion.7": "rpn-nms", "while.7": "roi-bwd"}}))
+    cmap = load_component_map(str(art))
+    assert "7" not in cmap
+    assert cmap["fusion.7"] == "rpn-nms"
+
+
+# ---- tools/op_microbench.py fast CPU smoke (tier-1) -----------------
+
+
+def test_op_microbench_cpu_smoke(tmp_path, capsys):
+    """The banked-artifact harness must keep running on CPU between
+    hardware windows: tiny shapes, one iter, the old-vs-new pairs, and
+    --bank writing the hardware-gated artifact (cpu-labeled here)."""
+    from tools import op_microbench
+
+    out_path = tmp_path / "mb.json"
+    op_microbench.main([
+        "--iters", "1", "--image-size", "128", "--pre-nms", "64",
+        "--batch", "1", "--ops", "nms_new,nms_old,matching_ga",
+        "--out", str(out_path), "--bank",
+        "--artifacts-dir", str(tmp_path / "artifacts")])
+    rec = json.loads(out_path.read_text())
+    assert rec["unit"] == "ms_per_call"
+    for op in ("nms_new", "nms_old", "matching_ga"):
+        assert isinstance(rec["results"][op], float), rec["results"]
+    assert "nms_new_minus_nms_old" in rec["new_minus_old_ms"]
+    # CPU run banks to the cpu-labeled artifact, never the tpu one
+    banked = json.loads(
+        (tmp_path / "artifacts" / "op_microbench_cpu.json").read_text())
+    assert "banked_at" in banked
+    assert not (tmp_path / "artifacts" / "op_microbench_tpu.json"
+                ).exists()
+    capsys.readouterr()

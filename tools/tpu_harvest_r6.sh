@@ -1,0 +1,117 @@
+#!/bin/bash
+# Round-6 harvest: the profile-attributed step-time pipeline on real
+# hardware.  Converts the first healthy tunnel window into the
+# evidence chain ISSUE 3 / VERDICT r5 next #1/#3/#5/#7 ask for:
+#   1. the full cheap-first ladder (now ends at the 1344/b8
+#      remat+bf16-param rung -> the >=13 img/s/chip candidate headline)
+#   2. per-change A/B at the b4 flagship: prefetch 0 vs 1
+#   3. profiled headline run -> profile/attribution.json (HLO
+#      component map) -> trace_summary --attribution (component_pct
+#      with "other" <=30, replacing the unreadable r5 profile)
+#   4. op_microbench --bank (old-vs-new per-op ladder, the part-2
+#      attribution mystery's second artifact)
+# Same tunnel discipline as r5*: one client at a time, port-wait,
+# never kill a running client.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tpu_harvest_r6.log
+
+say() { echo "[r6] $(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+wait_slot() {
+    while pgrep -af \
+        "python bench.py|tools/convergence_run.py|tools/op_microbench.py" \
+        2>/dev/null | grep -v "platform cpu" | grep -q .; do
+        sleep 60
+    done
+}
+
+wait_port() {
+    local n=0
+    while ! python - <<'EOF'
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", 8103), timeout=0.75).close()
+except OSError:
+    sys.exit(1)
+EOF
+    do
+        n=$((n + 1))
+        [ $((n % 20)) -eq 1 ] && say "tunnel port closed (x$n); waiting"
+        sleep 30
+    done
+}
+
+run_single() {  # run_single <tag> -- <bench args...>
+    local tag=$1; shift; shift  # consume tag and "--"
+    wait_slot
+    wait_port
+    say "run $tag: bench.py --single $*"
+    python bench.py --single "$@" \
+        --init-retries 3 --init-timeout 300 \
+        2>>"$LOG" | tail -1 > "artifacts/$tag.json.tmp"
+    if python -c "import json,sys; json.load(open(sys.argv[1]))" \
+        "artifacts/$tag.json.tmp" 2>/dev/null; then
+        mv "artifacts/$tag.json.tmp" "artifacts/$tag.json"
+        say "done $tag: $(head -c 200 "artifacts/$tag.json")"
+    else
+        rm -f "artifacts/$tag.json.tmp"
+        say "FAILED $tag: bench produced no JSON (see $LOG)"
+    fi
+}
+
+say "r6 harvest starting"
+
+# ---- 1. the ladder, through the b8 memory-plan rung ----------------
+wait_slot
+wait_port
+say "ladder (banks every rung incl. 1344_b8_remat)"
+python bench.py --steps 20 --init-retries 3 --init-timeout 300 \
+    2>>"$LOG" | tail -1 > artifacts/bench_ladder_r6.json.tmp
+mv artifacts/bench_ladder_r6.json.tmp artifacts/bench_ladder_r6.json \
+    2>/dev/null && say "ladder: $(head -c 200 artifacts/bench_ladder_r6.json)"
+
+# ---- 2. prefetch A/B at the b4 flagship ----------------------------
+run_single bench_1344_b4_prefetch0 -- --steps 15 --image-size 1344 \
+    --batch-size 4 --prefetch 0
+run_single bench_1344_b4_prefetch1 -- --steps 15 --image-size 1344 \
+    --batch-size 4 --prefetch 1
+
+# ---- 3. profiled headline + component-attributed summary -----------
+rm -f artifacts/bench_profiled_r6.json
+run_single bench_profiled_r6 -- --steps 10 --image-size 1344 \
+    --batch-size 4 --profile 8
+if python - <<'EOF'
+import json, sys
+try:
+    d = json.load(open("artifacts/bench_profiled_r6.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if (d.get("value") or 0) > 0 else 1)
+EOF
+then
+    # the attribution artifact was written by THIS profiled run
+    # (bench --profile banks profile/attribution.json alongside the
+    # trace), so summarize with component resolution
+    if python tools/trace_summary.py profile \
+        --attribution profile/attribution.json \
+        --out artifacts/profile_summary_r6.json >> "$LOG" 2>&1; then
+        say "component-attributed profile summary banked: $(python -c "
+import json
+d = json.load(open('artifacts/profile_summary_r6.json'))
+print('other', d.get('component_other_pct'))" 2>/dev/null)"
+    fi
+else
+    say "profiled bench failed; NOT summarizing the stale trace"
+fi
+
+# ---- 4. op microbench, banked-artifact mode ------------------------
+wait_slot
+wait_port
+say "op_microbench --bank (TPU, 1344 shapes)"
+python tools/op_microbench.py --iters 20 --image-size 1344 \
+    --batch 4 --pre-nms 2000 --bank >> "$LOG" 2>&1 \
+    && say "op_microbench banked: $(head -c 300 artifacts/op_microbench_tpu.json 2>/dev/null)" \
+    || say "op_microbench FAILED (see $LOG)"
+
+say "r6 harvest complete"
