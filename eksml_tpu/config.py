@@ -175,6 +175,34 @@ RESILIENCE_DATA_DEFAULTS = dict(
     FAULT_INJECT_EIO_COUNT=1,
 )
 
+# Telemetry knobs (eksml_tpu/telemetry/) — ONE source of truth, same
+# pattern as RESILIENCE_DATA_DEFAULTS: _define_defaults installs these
+# under TELEMETRY, and train._telemetry_knobs imports the same dict as
+# the fallback for pre-telemetry config trees.
+#
+# - ENABLED: master switch for the whole layer — False runs neither
+#   the exporter, the flight recorder, nor the cross-host aggregation
+#   collective (the debugging guarantee: "off" means off the
+#   collective path too).
+# - PORT: per-pod /metrics + /healthz HTTP port (charts annotate
+#   prometheus.io/scrape with the same value — keep them in lockstep).
+#   0 = bind an ephemeral port and publish it to
+#   <logdir>/telemetry-host<i>.port (the smoke-test contract).  A bind
+#   failure disables the exporter with a warning, never the run.
+# - AGGREGATE_HOSTS: cross-host min/max/mean + straggler attribution
+#   at each log interval (telemetry/aggregate.py HOST_AGG_KEYS).
+#   Host-side allgather outside jit, zero RNG — losses stay
+#   bit-identical; False skips the collective (and the hosts/*
+#   columns).
+# - FLIGHT_RECORDER_EVENTS: in-memory ring capacity; events also
+#   mirror to <logdir>/events-host<i>.jsonl (telemetry/recorder.py).
+TELEMETRY_DEFAULTS = dict(
+    ENABLED=True,
+    PORT=9090,
+    AGGREGATE_HOSTS=True,
+    FLIGHT_RECORDER_EVENTS=256,
+)
+
 
 def _define_defaults() -> None:
     # ---- mode flags (reference templates/maskrcnn.yaml:61-62) -------
@@ -388,6 +416,12 @@ def _define_defaults() -> None:
     # ---- data-ingest robustness (eksml_tpu/data/robust.py) ----------
     for k, v in RESILIENCE_DATA_DEFAULTS.items():
         setattr(_C.RESILIENCE.DATA, k, v)
+
+    # ---- telemetry (eksml_tpu/telemetry/) ---------------------------
+    # Registry → cross-host aggregation → OpenMetrics exporter /
+    # flight recorder; per-knob docs on TELEMETRY_DEFAULTS above.
+    for k, v in TELEMETRY_DEFAULTS.items():
+        setattr(_C.TELEMETRY, k, v)
 
     _C.freeze()
 

@@ -26,6 +26,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from eksml_tpu import telemetry
 from eksml_tpu.data.masks import polygons_to_bbox_mask, rle_decode
 from eksml_tpu.data.robust import (DataStarvationError, LoaderHealth,
                                    PermanentDataError, QuarantineLedger,
@@ -446,10 +447,17 @@ class DetectionLoader:
         if self._pool_rebuilds_left > 0:
             self._pool_rebuilds_left -= 1
             self._proc_pool = self._make_proc_pool()
+            self.health.note_pool_rebuild()
+            telemetry.default_registry().counter(
+                "eksml_data_pool_rebuilds",
+                "decode process-pool self-heals").inc()
+            telemetry.event("pool_rebuild",
+                            rebuilds_left=self._pool_rebuilds_left)
             log.warning("decode process pool rebuilt (%d rebuild(s) "
                         "left)", self._pool_rebuilds_left)
         else:
             self._pool_degraded = True  # no resurrection on re-iterate
+            telemetry.event("pool_degraded")
             log.warning(
                 "decode pool rebuild budget exhausted (RESILIENCE."
                 "DATA.MAX_POOL_REBUILDS) — degrading to in-thread "
@@ -854,6 +862,13 @@ class DevicePrefetcher:
         self.batches_delivered += 1
         if self._health is not None:
             self._health.note_prefetch_wait(wait_ms)
+        else:
+            # no LoaderHealth surface (direct fit callers): the wait
+            # still reaches the scrapeable registry
+            telemetry.default_registry().gauge(
+                "eksml_data_prefetch_wait_ms",
+                "device-prefetch blocking ms (ewma)"
+            ).set(self.wait_ms_ewma)
         return item
 
     def close(self) -> None:

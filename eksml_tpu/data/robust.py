@@ -45,6 +45,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from eksml_tpu import telemetry
+
 log = logging.getLogger(__name__)
 
 # Errno values that indicate the *filesystem* hiccuped, not that the
@@ -170,6 +172,10 @@ class RobustImageReader:
                 if attempts > 1:
                     with self._inject_lock:  # concurrent decode threads
                         self.transient_recoveries += 1
+                    telemetry.default_registry().counter(
+                        "eksml_data_io_recoveries",
+                        "transient I/O errors absorbed by bounded "
+                        "retry").inc()
                     log.info("transient I/O on %s recovered after %d "
                              "attempt(s)", path, attempts)
                 return image
@@ -277,6 +283,13 @@ class QuarantineLedger:
                     "substituting deterministically [%d/%d records, "
                     "%.1f%%]", entry["image_id"], kind, error,
                     self.count, self.total_records, 100 * frac)
+        telemetry.default_registry().counter(
+            "eksml_data_quarantined_records",
+            "distinct records quarantined by the data-ingest layer",
+            labels={"kind": kind}).inc()
+        telemetry.event("quarantine", image_id=entry["image_id"],
+                        path=entry["path"], fault_kind=kind,
+                        attempts=attempts)
         if self.path:
             # one write() per line: appends stay whole even when
             # multiple hosts share the logdir over NFS
@@ -323,6 +336,30 @@ class LoaderHealth:
         self._starvation_waits = 0
         self._prefetch_wait_ms_ewma: Optional[float] = None
         self._prefetch_batches = 0
+        self._pool_rebuilds = 0
+
+    def register_gauges(self, registry=None) -> None:
+        """Publish this health surface as collect-time gauges in the
+        telemetry registry (``eksml_data_*``) — the /metrics view of
+        the same numbers :meth:`scalars` feeds the metric stream.
+        Re-registering simply points the series at the newest loader
+        (callback semantics, registry.Gauge.set_function)."""
+        registry = registry or telemetry.default_registry()
+
+        def from_scalars(key):
+            return lambda: float(self.scalars().get(key, 0.0))
+
+        for key, help_text in (
+            ("queue_depth", "host batch queue depth"),
+            ("batches_produced", "batches built by the producer"),
+            ("starvation_waits", "consumer waits on an empty queue"),
+            ("batch_build_ms", "batch assembly ms (ewma)"),
+            ("prefetch_wait_ms", "device-prefetch blocking ms (ewma)"),
+            ("quarantined", "distinct quarantined records"),
+            ("quarantine_frac", "quarantined fraction of the shard"),
+        ):
+            registry.gauge(f"eksml_data_{key}", help_text
+                           ).set_function(from_scalars(key))
 
     # -- producer side ------------------------------------------------
 
@@ -346,6 +383,12 @@ class LoaderHealth:
     def note_starvation_wait(self) -> None:
         with self._lock:
             self._starvation_waits += 1
+        telemetry.event("starvation")
+
+    def note_pool_rebuild(self) -> None:
+        """Decode process-pool self-heal (loader._heal_proc_pool)."""
+        with self._lock:
+            self._pool_rebuilds += 1
 
     def note_prefetch_wait(self, ms: float) -> None:
         """Per-batch time the step loop blocked on the device
@@ -367,12 +410,16 @@ class LoaderHealth:
                 "queue_depth": float(self.queue_depth()),
                 "batches_produced": float(self._batches_produced),
                 "starvation_waits": float(self._starvation_waits),
+                "pool_rebuilds": float(self._pool_rebuilds),
             }
             if self._build_ms_ewma is not None:
                 out["batch_build_ms"] = round(self._build_ms_ewma, 2)
             if self._prefetch_wait_ms_ewma is not None:
                 out["prefetch_wait_ms"] = round(
                     self._prefetch_wait_ms_ewma, 2)
+        if self.reader is not None:
+            out["io_recoveries"] = float(
+                self.reader.transient_recoveries)
         if self.ledger is not None:
             out["quarantined"] = float(self.ledger.count)
             out["quarantine_frac"] = self.ledger.fraction

@@ -78,14 +78,24 @@ class PreemptionHandler:
     # -- signal plumbing ----------------------------------------------
 
     def _on_signal(self, signum, frame):  # noqa: ARG002 (signal API)
-        if not self._flag.is_set():
+        # FLAG FIRST, and nothing lock-taking after it: the handler
+        # runs between bytecodes on the main thread, which holds the
+        # telemetry registry/recorder locks many times per log
+        # interval — a counter inc or flight-recorder write here
+        # would deadlock against the interrupted critical section and
+        # the forced checkpoint would never happen.  The telemetry
+        # publish for this signal (counter + "sigterm" event) is
+        # emitted by the fit loop at the step boundary
+        # (train._graceful_exit), outside signal context.
+        first = not self._flag.is_set()
+        self._flag.set()
+        if first:
             self.signal_time = time.time()
             # log from signal context is re-entrant-unsafe in theory;
             # in practice the logging module masks its own locks and
             # this fires once.  Keep it to one line.
             log.warning("received signal %d: requesting forced "
                         "checkpoint at the next step boundary", signum)
-        self._flag.set()
 
     def install(self) -> "PreemptionHandler":
         """Install handlers (main thread only — signal module rule).

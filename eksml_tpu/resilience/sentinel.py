@@ -29,6 +29,8 @@ import logging
 import math
 from typing import List, Optional, Tuple
 
+from eksml_tpu import telemetry
+
 log = logging.getLogger(__name__)
 
 OK = "ok"
@@ -63,6 +65,11 @@ class DivergenceSentinel:
         self._consecutive_bad += 1
         if self.first_bad_step is None:
             self.first_bad_step = step
+        telemetry.default_registry().counter(
+            "eksml_resilience_nonfinite_losses",
+            "non-finite total_loss observations").inc()
+        telemetry.event("nan_observed", step=step, loss=repr(loss),
+                        consecutive=self._consecutive_bad)
         log.warning("non-finite total_loss=%r at step %d (%d/%d "
                     "consecutive)", loss, step, self._consecutive_bad,
                     self.patience)
@@ -83,6 +90,9 @@ class DivergenceSentinel:
         """Record a rollback; raises :class:`DivergenceError` once the
         budget is exhausted."""
         self.rollbacks.append((from_step, to_step))
+        telemetry.default_registry().counter(
+            "eksml_resilience_rollbacks",
+            "divergence rollbacks to a previous checkpoint").inc()
         if len(self.rollbacks) > self.max_rollbacks:
             raise DivergenceError(self.diagnostic(
                 f"exceeded RESILIENCE.MAX_ROLLBACKS={self.max_rollbacks}"))

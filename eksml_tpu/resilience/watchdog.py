@@ -139,6 +139,16 @@ class HangWatchdog:
                     "watchdog: no progress for %.1fs (deadline %.1fs) — "
                     "stalled in phase %r at step %s; all-thread stack "
                     "report: %s", elapsed, deadline, phase, step, path)
+                # telemetry publish AFTER the dump: the report is the
+                # evidence; the event/counter point at it
+                from eksml_tpu import telemetry
+
+                telemetry.default_registry().counter(
+                    "eksml_resilience_watchdog_fires",
+                    "hang-watchdog deadline expiries").inc()
+                telemetry.event("watchdog_dump", step=step,
+                                phase=phase, report=path,
+                                stalled_sec=round(elapsed, 1))
             except Exception:
                 log.exception("watchdog report failed")
             if self.on_hang is not None:

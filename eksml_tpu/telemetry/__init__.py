@@ -1,0 +1,30 @@
+"""Unified telemetry: registry → cross-host aggregation → exporter /
+flight recorder.
+
+The observability layer (ISSUE 4).  Data flow::
+
+    subsystems ──publish──▶ MetricRegistry ──▶ /metrics (OpenMetrics,
+    (train/data/resilience)      │               every pod)
+                                 └──▶ fit loop ──▶ cross-host
+                                      aggregation ──▶ rank-0
+                                      metrics.jsonl / TB rows
+    resilience transitions ──event()──▶ FlightRecorder ──▶
+        events-host<i>.jsonl + watchdog report tail +
+        tools/run_report.py post-mortems
+
+Config knobs live under ``config.TELEMETRY``; chart plumbing
+(prometheus.io/scrape annotations, container port) in
+charts/maskrcnn*/templates.
+"""
+
+from eksml_tpu.telemetry.aggregate import (HOST_AGG_KEYS,  # noqa: F401
+                                           aggregate_host_scalars,
+                                           publish_aggregates,
+                                           stats_from_matrix)
+from eksml_tpu.telemetry.exporter import (TelemetryExporter,  # noqa: F401
+                                          render_openmetrics)
+from eksml_tpu.telemetry.recorder import (FlightRecorder,  # noqa: F401
+                                          event, events_path_for, get,
+                                          install)
+from eksml_tpu.telemetry.registry import (MetricRegistry,  # noqa: F401
+                                          default_registry)

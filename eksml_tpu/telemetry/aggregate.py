@@ -1,0 +1,110 @@
+"""Cross-host metric aggregation + straggler attribution.
+
+The reproduction inherited the reference's blind spot: ``Trainer``
+writes metrics only where ``process_index() == 0``, so a fleet of N
+hosts reports ONE host's step time, prefetch wait and quarantine
+census — the straggler that sets the synchronous step rate (MegaScale
+§5, Jiang et al. 2024, makes exactly this attribution the core of its
+production tooling) is invisible unless it happens to be rank 0.
+
+At every log interval each host contributes one fixed-order vector of
+host-local scalars (:data:`HOST_AGG_KEYS`); a host-side allgather over
+the existing ``parallel/`` collective layer (the same
+``process_allgather`` transport ``cross_host_sum`` uses) yields the
+full H×K matrix, from which rank 0's ``metrics.jsonl``/TB row gains
+``hosts/<key>_min|_max|_mean`` plus ``hosts/lagging`` (the argmax-
+step-time host index).  Guarantees the acceptance bit-identity rests
+on:
+
+- runs OUTSIDE jit on already-materialized host floats — the compiled
+  train step and its HLO are untouched;
+- consumes ZERO RNG — nothing about batch order or sampling changes;
+- every host calls it at the same steps (the log-step predicate is a
+  pure function of step counters that are identical on all hosts), the
+  invariant any collective needs;
+- the key set is FIXED (missing values default 0.0), so the gathered
+  pytree structure can never diverge across hosts.
+
+Single-process runs skip the collective entirely (min = max = mean =
+the local value) so the row/registry contract is identical at any
+world size.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+# One fixed, ordered contract for the gathered vector.  Extend by
+# appending (order is the wire format for one log interval, but every
+# host runs the same code so any change is globally atomic).
+HOST_AGG_KEYS: Tuple[str, ...] = (
+    "step_time_ms",       # wall time per step over the log interval
+    "prefetch_wait_ms",   # step-loop blocking on the device prefetcher
+    "batch_build_ms",     # producer-side batch assembly time
+    "quarantined",        # distinct bad records on this host
+    "io_recoveries",      # transient I/O blips absorbed by retry
+    "pool_rebuilds",      # decode process-pool self-heals
+    "starvation_waits",   # consumer waits on an empty batch queue
+)
+
+
+def host_vector(values: Dict[str, float]) -> np.ndarray:
+    """``values`` → the fixed-order float64 vector (missing keys 0)."""
+    return np.asarray([float(values.get(k, 0.0) or 0.0)
+                       for k in HOST_AGG_KEYS], np.float64)
+
+
+def stats_from_matrix(matrix: np.ndarray,
+                      lag_key: str = "step_time_ms") -> Dict[str, float]:
+    """H×K gathered matrix → the flat aggregate row.
+
+    Split out from the collective so the multi-host math is unit-
+    testable without multiple processes."""
+    matrix = np.asarray(matrix, np.float64).reshape(
+        -1, len(HOST_AGG_KEYS))
+    out: Dict[str, float] = {"hosts/count": float(matrix.shape[0])}
+    for j, k in enumerate(HOST_AGG_KEYS):
+        col = matrix[:, j]
+        out[f"hosts/{k}_min"] = float(col.min())
+        out[f"hosts/{k}_max"] = float(col.max())
+        out[f"hosts/{k}_mean"] = float(col.mean())
+    lag_col = matrix[:, HOST_AGG_KEYS.index(lag_key)]
+    # straggler attribution: the host whose step wall time bounds the
+    # synchronous step rate this interval
+    out["hosts/lagging"] = float(int(np.argmax(lag_col)))
+    return out
+
+
+def aggregate_host_scalars(values: Dict[str, float]
+                           ) -> Dict[str, float]:
+    """Gather this host's :data:`HOST_AGG_KEYS` values across all
+    processes and return the min/max/mean + straggler row.
+
+    COLLECTIVE in multi-process runs: every host must call it at the
+    same step (the fit loop calls it unconditionally at log steps).
+    """
+    vec = host_vector(values)
+    import jax  # deferred: single-process math needs no backend below
+
+    if jax.process_count() <= 1:
+        return stats_from_matrix(vec[None, :])
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(multihost_utils.process_allgather(vec))
+    return stats_from_matrix(gathered)
+
+
+def publish_aggregates(agg: Dict[str, float], registry=None) -> None:
+    """Mirror the aggregate row into registry gauges
+    (``eksml_hosts_<key>_<stat>``) so ``/metrics`` serves the same
+    fleet view the JSONL row records."""
+    from eksml_tpu.telemetry.registry import default_registry
+
+    registry = registry or default_registry()
+    for k, v in agg.items():
+        name = "eksml_" + k.replace("/", "_")
+        registry.gauge(
+            name, "cross-host aggregate (telemetry/aggregate.py)"
+        ).set(v)

@@ -1,0 +1,217 @@
+"""Dependency-free OpenMetrics HTTP exporter (+ ``/healthz``).
+
+The reference's only metric surface is a TensorBoard side-service
+scraping rank-0's event files off the shared filesystem — per-host
+signals on the other N-1 hosts are invisible, and nothing is
+machine-scrapeable (SURVEY.md §5.5).  This serves the process-local
+:class:`~eksml_tpu.telemetry.registry.MetricRegistry` from EVERY pod:
+
+- ``GET /metrics`` — OpenMetrics text format, strict enough for a
+  Prometheus scrape (``# TYPE``/``# HELP`` per family, counters
+  exposed with the ``_total`` suffix, cumulative histogram buckets
+  with the ``+Inf`` bound, terminating ``# EOF``).
+- ``GET /healthz`` — JSON liveness with process uptime plus whatever
+  the installable ``health_fn`` reports (the fit loop wires last-step
+  info), for the pod's HTTP probes.
+
+The charts annotate the training pods with ``prometheus.io/scrape``
+(see charts/maskrcnn/templates/maskrcnn.yaml), so any standard
+annotation-driven Prometheus discovers all hosts with zero extra
+config.  Serving uses a daemon-threaded stdlib HTTP server — no new
+dependency, and a hung scrape can never block the step loop.
+
+A bind failure (port in use on a shared dev box) logs one warning and
+leaves the exporter disabled: observability must never take down
+training.  ``port=0`` binds an ephemeral port; the bound port is
+published via :attr:`TelemetryExporter.port` and optionally a
+``port_file`` (the smoke tests' discovery contract).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from eksml_tpu.telemetry.registry import (COUNTER, GAUGE, HISTOGRAM,
+                                          MetricRegistry,
+                                          default_registry)
+
+log = logging.getLogger(__name__)
+
+CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8")
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_str(labels, extra: Optional[Dict[str, str]] = None) -> str:
+    pairs = list(labels) + sorted((extra or {}).items())
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def render_openmetrics(registry: Optional[MetricRegistry] = None) -> str:
+    """The registry as an OpenMetrics text exposition (ends ``# EOF``)."""
+    registry = registry or default_registry()
+    out = []
+    for fam in registry.collect():
+        out.append(f"# TYPE {fam.name} {fam.kind}")
+        if fam.help:
+            out.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        for key in sorted(fam.series):
+            s = fam.series[key]
+            if fam.kind == COUNTER:
+                out.append(f"{fam.name}_total{_labels_str(key)} "
+                           f"{_fmt(s.value)}")
+            elif fam.kind == GAUGE:
+                out.append(f"{fam.name}{_labels_str(key)} "
+                           f"{_fmt(s.value)}")
+            elif fam.kind == HISTOGRAM:
+                cum, total_sum, count = s.snapshot()
+                bounds = [_fmt(b) for b in s.buckets] + ["+Inf"]
+                for bound, c in zip(bounds, cum):
+                    ls = _labels_str(key, {"le": bound})
+                    out.append(f"{fam.name}_bucket{ls} {c}")
+                out.append(f"{fam.name}_count{_labels_str(key)} {count}")
+                out.append(f"{fam.name}_sum{_labels_str(key)} "
+                           f"{_fmt(total_sum)}")
+    out.append("# EOF")
+    return "\n".join(out) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set by the exporter on the handler class it instantiates
+    exporter: "TelemetryExporter"
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            try:
+                body = render_openmetrics(
+                    self.exporter.registry).encode("utf-8")
+            except Exception:  # noqa: BLE001 — scrape must not 500 the pod
+                log.exception("metric exposition failed")
+                self.send_error(500)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/healthz":
+            payload = {"status": "ok",
+                       "uptime_sec": round(
+                           time.monotonic()
+                           - self.exporter.started_monotonic, 1)}
+            fn = self.exporter.health_fn
+            if fn is not None:
+                try:
+                    payload.update(fn())
+                except Exception:  # noqa: BLE001 — health stays up
+                    payload["health_fn_error"] = True
+            body = (json.dumps(payload) + "\n").encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def log_message(self, fmt, *args):  # scrapes are not pod-log news
+        log.debug("telemetry http: " + fmt, *args)
+
+
+class TelemetryExporter:
+    """Threaded exporter bound to ``addr:port`` (0 = ephemeral)."""
+
+    def __init__(self, port: int = 9090, addr: str = "0.0.0.0",
+                 registry: Optional[MetricRegistry] = None,
+                 health_fn: Optional[Callable[[], Dict]] = None,
+                 port_file: Optional[str] = None):
+        self.registry = registry or default_registry()
+        self.health_fn = health_fn
+        self.requested_port = int(port)
+        self.addr = addr
+        self.port_file = port_file
+        self.started_monotonic = time.monotonic()
+        self.port: Optional[int] = None  # bound port once started
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "TelemetryExporter":
+        if self._server is not None:
+            return self
+        handler = type("BoundHandler", (_Handler,), {"exporter": self})
+        try:
+            server = ThreadingHTTPServer((self.addr, self.requested_port),
+                                         handler)
+        except OSError as e:
+            # never fatal: on a shared box (or hosts co-scheduled on
+            # one node) only the first process wins the fixed port
+            log.warning("telemetry exporter disabled: cannot bind "
+                        "%s:%d (%s)", self.addr, self.requested_port, e)
+            return self
+        server.daemon_threads = True
+        self._server = server
+        self.port = server.server_address[1]
+        self.started_monotonic = time.monotonic()
+        self._thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.5},
+            name="eksml-telemetry-http", daemon=True)
+        self._thread.start()
+        if self.port_file:
+            # write-then-rename: a reader polling for the file's
+            # existence must never catch it created-but-empty (the
+            # chaos rungs parse it the instant it appears)
+            try:
+                tmp = self.port_file + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(str(self.port))
+                os.replace(tmp, self.port_file)
+            except OSError:
+                log.warning("could not write telemetry port file %s",
+                            self.port_file)
+        log.info("telemetry exporter serving /metrics and /healthz "
+                 "on port %d", self.port)
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._server is not None
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.port = None

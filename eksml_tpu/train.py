@@ -49,6 +49,7 @@ from eksml_tpu.parallel.collectives import set_xla_collective_flags
 from eksml_tpu.resilience import (HangWatchdog, PreemptedError,
                                   PreemptionHandler)
 from eksml_tpu.resilience.sentinel import ROLLBACK, DivergenceSentinel
+from eksml_tpu import telemetry
 from eksml_tpu.utils import CheckpointManager, MetricWriter
 
 log = logging.getLogger("eksml_tpu.train")
@@ -133,6 +134,23 @@ def make_optimizer(cfg):
     return optax.chain(*chain), sched
 
 
+def _telemetry_knobs(cfg) -> Dict[str, Any]:
+    """TELEMETRY values with fallbacks for callers that hand the
+    trainer a config tree predating the telemetry knobs (same pattern
+    as the loader's ``_data_knobs``) — defaults are the canonical
+    ``TELEMETRY_DEFAULTS`` (one source of truth)."""
+    from eksml_tpu.config import TELEMETRY_DEFAULTS
+
+    out = dict(TELEMETRY_DEFAULTS)
+    node = getattr(cfg, "TELEMETRY", None)
+    if node is not None:
+        for k in out:
+            v = getattr(node, k, None)
+            if v is not None and not hasattr(v, "to_dict"):
+                out[k] = v
+    return out
+
+
 def cast_params_for_storage(params, param_dtype: str):
     """TRAIN.PARAM_DTYPE storage cast (the 1344/b8 memory plan): f32
     leaves → bf16; everything else keeps its dtype.  ONE definition
@@ -144,6 +162,54 @@ def cast_params_for_storage(params, param_dtype: str):
     return jax.tree.map(
         lambda x: (x.astype(jnp.bfloat16)
                    if x.dtype == jnp.float32 else x), params)
+
+
+def _preregister_core_metrics(registry) -> None:
+    """Create the always-present series so the FIRST scrape of a
+    healthy run already shows every resilience/data counter at 0 —
+    dashboards and alerts key on existence, not just increments."""
+    for name, help_text in (
+        ("eksml_resilience_preemptions",
+         "SIGTERM preemption signals observed"),
+        ("eksml_resilience_rollbacks",
+         "divergence rollbacks to a previous checkpoint"),
+        ("eksml_resilience_nonfinite_losses",
+         "non-finite total_loss observations (divergence sentinel)"),
+        ("eksml_resilience_watchdog_fires",
+         "hang-watchdog deadline expiries (stack reports written)"),
+        ("eksml_data_io_recoveries",
+         "transient I/O errors absorbed by bounded retry"),
+        ("eksml_data_pool_rebuilds",
+         "decode process-pool self-heals after a worker death"),
+        ("eksml_checkpoint_saves", "checkpoint commits started"),
+        ("eksml_checkpoint_restores", "checkpoint restores completed"),
+        ("eksml_checkpoint_fallbacks",
+         "checkpoint integrity walk-backs to an earlier step"),
+    ):
+        registry.counter(name, help_text)
+    # the quarantine census is labeled by fault kind everywhere it
+    # increments (robust.py) — preregister the SAME series, not a bare
+    # one that would sit at 0 forever next to the real counters
+    for kind in ("decode", "missing", "io_exhausted"):
+        registry.counter(
+            "eksml_data_quarantined_records",
+            "distinct records quarantined by the data-ingest layer",
+            labels={"kind": kind})
+
+
+def _config_digest(cfg) -> str:
+    """Short stable digest of the finalized config — the run_start
+    header field run_report.py uses to tell a relaunch-with-identical-
+    config from a restart that changed hyperparameters."""
+    import hashlib
+
+    from eksml_tpu.config import dump_config
+
+    try:
+        return hashlib.sha256(
+            dump_config(cfg).encode()).hexdigest()[:12]
+    except Exception:  # noqa: BLE001 — a digest must never block a run
+        return "unknown"
 
 
 class Trainer:
@@ -188,9 +254,26 @@ class Trainer:
         self.tx, self.sched = make_optimizer(cfg)
         # write_metrics=False gives read-only consumers (eval_ckpt) a
         # Trainer that never touches the run's metrics.jsonl/TB events
-        self.writer = (MetricWriter(logdir)
+        # (or its flight-recorder event files)
+        self._telemetry = _telemetry_knobs(cfg)
+        run_info = {"config_digest": _config_digest(cfg)}
+        self.writer = (MetricWriter(logdir, run_info=run_info)
                        if write_metrics and jax.process_index() == 0
                        else None)
+        self.recorder = None
+        if write_metrics and self._telemetry["ENABLED"]:
+            # one flight recorder per HOST (unlike the rank-0 writer):
+            # resilience incidents are per-host facts
+            prev = telemetry.install(telemetry.FlightRecorder(
+                capacity=int(self._telemetry["FLIGHT_RECORDER_EVENTS"]),
+                path=telemetry.events_path_for(
+                    logdir, jax.process_index()),
+                host_id=jax.process_index()))
+            if prev is not None:
+                prev.close()  # a prior Trainer's recorder in this proc
+            self.recorder = telemetry.get()
+            telemetry.event("run_start", pid=os.getpid(),
+                            host_count=jax.process_count(), **run_info)
         self.ckpt = CheckpointManager(
             logdir, digest=cfg.RESILIENCE.CHECKPOINT_DIGEST)
 
@@ -362,6 +445,32 @@ class Trainer:
                 # timing, quarantine stats alongside the thread stacks
                 watchdog.add_report_provider("data pipeline",
                                              data_health.report)
+            if self.recorder is not None:
+                # tail of the flight recorder = what happened BEFORE
+                # the stall — usually the diagnosis
+                watchdog.add_report_provider("flight recorder",
+                                             self.recorder.report)
+
+        # telemetry: pre-register the core series (a scrape before the
+        # first incident must still show the counters at 0), publish
+        # the loader's health surface as collect-time gauges, and serve
+        # /metrics + /healthz from THIS pod while the loop runs
+        registry = telemetry.default_registry()
+        _preregister_core_metrics(registry)
+        if data_health is not None:
+            data_health.register_gauges(registry)
+        health_state = {"step": start_step, "total_steps": total_steps}
+        exporter = None
+        # ENABLED is the master switch for the whole layer: without it
+        # neither the exporter NOR the aggregation collective runs
+        aggregate_hosts = bool(self._telemetry["ENABLED"]
+                               and self._telemetry["AGGREGATE_HOSTS"])
+        # distinct family name from the eksml_train_step_time_ms GAUGE
+        # the MetricWriter mirror creates for the step_time_ms scalar —
+        # one name must mean one type (registry enforces it)
+        step_time_hist = registry.histogram(
+            "eksml_train_step_duration_ms",
+            "wall time per training step (log-interval mean)")
         sentinel = DivergenceSentinel(patience=res.NAN_PATIENCE,
                                       max_rollbacks=res.MAX_ROLLBACKS)
         nan_injected = False
@@ -384,6 +493,18 @@ class Trainer:
 
         step = start_step
         try:
+            # exporter starts INSIDE the try so any setup failure
+            # below still reaches the finally that stops it — a leaked
+            # server would squat the fixed port and keep serving stale
+            # health state to probes
+            if self._telemetry["ENABLED"]:
+                exporter = telemetry.TelemetryExporter(
+                    port=int(self._telemetry["PORT"]),
+                    health_fn=lambda: dict(health_state),
+                    port_file=os.path.join(
+                        self.logdir,
+                        f"telemetry-host{jax.process_index()}.port"),
+                ).start()
             for batch in source:
                 if watchdog:
                     watchdog.beat("globalize_batch", step)
@@ -405,6 +526,7 @@ class Trainer:
                     watchdog.end_compile_headroom()
                 step += 1
                 steps_since_log += 1
+                health_state["step"] = step
 
                 if (res.FAULT_INJECT_NAN_STEP and not nan_injected
                         and step == res.FAULT_INJECT_NAN_STEP):
@@ -478,7 +600,23 @@ class Trainer:
                     # overstated throughput
                     metrics["images_per_sec"] = (
                         imgs_per_step * steps_since_log / max(dt, 1e-9))
+                    step_time_ms = (dt * 1000.0
+                                    / max(1, steps_since_log))
+                    metrics["step_time_ms"] = round(step_time_ms, 2)
+                    step_time_hist.observe(step_time_ms)
                     steps_since_log = 0
+                    if aggregate_hosts:
+                        # cross-host min/max/mean + straggler index:
+                        # host-side allgather OUTSIDE jit, zero RNG —
+                        # a collective, so it runs on EVERY host at
+                        # this (host-identical) log step, not just
+                        # where the writer lives
+                        hv = {k: metrics.get(f"data/{k}", 0.0)
+                              for k in telemetry.HOST_AGG_KEYS}
+                        hv["step_time_ms"] = step_time_ms
+                        agg = telemetry.aggregate_host_scalars(hv)
+                        telemetry.publish_aggregates(agg, registry)
+                        metrics.update(agg)
                     if self.writer:
                         self.writer.write_scalars(step, metrics)
                     log.info("step %d/%d loss=%.4f (%.1f img/s)", step,
@@ -499,6 +637,9 @@ class Trainer:
                             "skipping checkpoint at step %d: last "
                             "observed total_loss is non-finite "
                             "(divergence sentinel)", step)
+                        telemetry.event(
+                            "checkpoint_skipped", step=step,
+                            reason="non-finite loss observation")
                     else:
                         # hand Orbax the sharded jax arrays directly:
                         # async checkpointing snapshots to host (brief
@@ -511,10 +652,15 @@ class Trainer:
                             watchdog.beat("checkpoint_save", step)
                         t_save = time.time()
                         self.ckpt.save(step, state)
+                        save_ms = (time.time() - t_save) * 1000
+                        registry.histogram(
+                            "eksml_checkpoint_save_ms",
+                            "step-loop blocking time of a checkpoint "
+                            "save (async snapshot + dispatch)"
+                        ).observe(save_ms)
                         if self.writer:
                             self.writer.write_scalars(step, {
-                                "checkpoint_save_ms":
-                                    (time.time() - t_save) * 1000})
+                                "checkpoint_save_ms": save_ms})
                 if self.eval_fn and (step % eval_every == 0
                                      or step == total_steps):
                     if watchdog:
@@ -551,6 +697,10 @@ class Trainer:
                 # batches — an exception mid-loop must not leak the
                 # thread or pin prefetched HBM
                 prefetcher.close()
+            if exporter is not None:
+                # the scrape endpoint dies with the loop it describes;
+                # a relaunch (or a later fit) re-binds cleanly
+                exporter.stop()
             # always drain the async checkpoint thread and buffered
             # metrics — an exception mid-loop must not abandon an
             # in-flight save or lose the last metric rows.  A drain
@@ -588,6 +738,8 @@ class Trainer:
             raise sentinel.no_checkpoint_to_restore(step)
         good, good_step = restored
         sentinel.register_rollback(step, good_step)
+        telemetry.event("rollback", step=step, to_step=good_step,
+                        first_bad_step=sentinel.first_bad_step)
         if self.writer:
             self.writer.write_scalars(
                 good_step, {"resilience/rollback_from": float(step)})
@@ -604,6 +756,15 @@ class Trainer:
         (one device sync — the process is exiting anyway) rather than
         the sentinel's possibly steps-old observation, so a recovered
         blip cannot block the forced save."""
+        # telemetry for the signal is published HERE, not in the
+        # signal handler — the handler must stay flag-only (a lock
+        # acquisition in signal context deadlocks against whatever
+        # critical section it interrupted, see preemption._on_signal)
+        telemetry.default_registry().counter(
+            "eksml_resilience_preemptions",
+            "SIGTERM preemption signals observed").inc()
+        telemetry.event("sigterm", step=step,
+                        signal_time=preempt.signal_time)
         # land any in-flight periodic commit first; if THIS step was
         # just checkpointed in the same iteration, a forced re-save
         # would delete and rewrite it — doubling the commit cost the
@@ -630,6 +791,8 @@ class Trainer:
             self.writer.write_scalars(
                 step, {"resilience/preempted": 1.0})
             self.writer.flush()
+        telemetry.event("preempt_exit", step=step,
+                        exit_code=preempt.exit_code)
         raise preempt.preempted(step)
 
     def _run_eval(self, state, step):

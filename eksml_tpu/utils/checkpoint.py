@@ -26,6 +26,7 @@ from typing import Any, List, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from eksml_tpu import telemetry
 from eksml_tpu.resilience import integrity
 
 log = logging.getLogger(__name__)
@@ -59,6 +60,11 @@ class CheckpointManager:
             # its manifest before tracking the new in-flight one.
             self._write_pending_manifests(exclude=step)
             self._manifest_pending.add(step)
+            telemetry.default_registry().counter(
+                "eksml_checkpoint_saves",
+                "checkpoint commits started").inc()
+            telemetry.event("checkpoint_save", step=step,
+                            forced=bool(force))
         return saved
 
     def _write_pending_manifests(self, exclude: Optional[int] = None) -> None:
@@ -149,6 +155,10 @@ class CheckpointManager:
             # together, or the lone failing host blocks forever in the
             # next broadcast while the others train
             if self._agreed_ok(err is None):
+                telemetry.default_registry().counter(
+                    "eksml_checkpoint_restores",
+                    "checkpoint restores completed").inc()
+                telemetry.event("checkpoint_restore", step=step)
                 return out, step
             # the raise-vs-walk-back verdict must ALSO be one
             # decision for all hosts: per-host manifest visibility
@@ -172,6 +182,11 @@ class CheckpointManager:
             log.warning("checkpoint restore of step %d failed on at "
                         "least one host (local error: %s) — falling "
                         "back to an earlier step", step, err)
+            telemetry.default_registry().counter(
+                "eksml_checkpoint_fallbacks",
+                "checkpoint integrity walk-backs").inc()
+            telemetry.event("checkpoint_fallback", step=step,
+                            error=repr(err))
             self._quarantine(step)
 
     @staticmethod
@@ -231,6 +246,7 @@ class CheckpointManager:
     def _quarantine(self, step: int) -> None:
         if jax.process_index() == 0:
             integrity.quarantine_step(self.directory, step)
+            telemetry.event("checkpoint_quarantined", step=step)
         self._reload()
 
     def _reload(self) -> None:

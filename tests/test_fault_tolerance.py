@@ -121,6 +121,35 @@ def _steps_logged(logdir):
             if "total_loss" in r]
 
 
+def _event_kinds(logdir, host=0):
+    """Flight-recorder event kinds, file order (= time order per
+    host) — the post-mortem contract the telemetry rungs assert."""
+    path = os.path.join(logdir, f"events-host{host}.jsonl")
+    kinds = []
+    if os.path.exists(path):
+        for line in open(path):
+            try:
+                kinds.append(json.loads(line)["kind"])
+            except (json.JSONDecodeError, KeyError):
+                continue
+    return kinds
+
+
+def _scrape_metrics(logdir, host=0, budget=60):
+    """Read the trainer's ephemeral exporter port (TELEMETRY.PORT=0
+    writes it to <logdir>/telemetry-host<i>.port) and scrape /metrics."""
+    import urllib.request
+
+    port_file = os.path.join(logdir, f"telemetry-host{host}.port")
+    deadline = time.time() + budget
+    while not os.path.exists(port_file):
+        assert time.time() < deadline, "telemetry port file never appeared"
+        time.sleep(0.2)
+    port = int(open(port_file).read())
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+
+
 def _wait_for_first_step(proc, logdir, log_path, budget=900):
     deadline = time.time() + budget
     while time.time() < deadline:
@@ -187,14 +216,27 @@ def test_sigterm_graceful_preempt_then_resume(tmp_path, compile_cache):
     resumable code, and the relaunch loses at most the in-flight step."""
     logdir = str(tmp_path / "run")
     # checkpoint period of 2 epochs = every 4 steps, so the forced
-    # save is distinguishable from a periodic one at early steps
+    # save is distinguishable from a periodic one at early steps;
+    # TELEMETRY.PORT=0 = ephemeral exporter port published to the
+    # logdir (the acceptance scrape below)
     config = [c for c in TINY if "CHECKPOINT_PERIOD" not in c] + [
-        "TRAIN.CHECKPOINT_PERIOD=2"]
+        "TRAIN.CHECKPOINT_PERIOD=2", "TELEMETRY.PORT=0"]
 
     log1 = str(tmp_path / "run1.log")
     proc = _launch(logdir, compile_cache, log1, config)
     try:
         _wait_for_first_step(proc, logdir, log1)
+        # acceptance scrape (ISSUE 4): a live smoke train serves valid
+        # OpenMetrics with an aggregated host_max gauge and the
+        # resilience counters, from the ephemeral port it published
+        from test_telemetry import parse_openmetrics
+
+        fams = parse_openmetrics(_scrape_metrics(logdir))
+        assert fams["eksml_hosts_step_time_ms_max"]["samples"][
+            "eksml_hosts_step_time_ms_max"] > 0.0
+        assert fams["eksml_resilience_preemptions"]["samples"][
+            "eksml_resilience_preemptions_total"] == 0.0
+        assert "eksml_train_total_loss" in fams
         proc.send_signal(signal.SIGTERM)  # k8s grace window begins
         rc = proc.wait(timeout=300)       # forced commit, then exit
     finally:
@@ -219,6 +261,14 @@ def test_sigterm_graceful_preempt_then_resume(tmp_path, compile_cache):
     committed = _committed_ckpt_steps(logdir)
     assert committed, "graceful preemption must leave a checkpoint"
     assert max(committed) == max(first_steps), (committed, first_steps)
+    # flight recorder (ISSUE 4): the preemption chain landed in
+    # events-host0.jsonl IN ORDER — signal seen, forced commit,
+    # resumable exit (indexes, not positions: a periodic save may
+    # legitimately precede the signal)
+    kinds = _event_kinds(logdir)
+    i_sig, i_exit = kinds.index("sigterm"), kinds.index("preempt_exit")
+    assert i_sig < i_exit, kinds
+    assert "checkpoint_save" in kinds[i_sig:i_exit], kinds
 
     log2 = str(tmp_path / "run2.log")
     proc2 = _launch(logdir, compile_cache, log2, config)
@@ -235,6 +285,12 @@ def test_sigterm_graceful_preempt_then_resume(tmp_path, compile_cache):
     second_run_steps = steps[len(first_steps):]
     assert second_run_steps == list(range(max(committed) + 1, 7)), (
         committed, first_steps, second_run_steps)
+    # the relaunch appended its own run_start + restore events to the
+    # SAME per-host event file — one segmented post-mortem stream
+    kinds = _event_kinds(logdir)
+    assert kinds.count("run_start") == 2, kinds
+    assert "checkpoint_restore" in kinds[kinds.index("preempt_exit"):], (
+        kinds)
 
 
 # ---- rung 3: corrupt latest checkpoint -------------------------------
@@ -342,6 +398,37 @@ def test_nan_loss_rolls_back_and_never_checkpoints_poison(
     # every committed checkpoint postdates recovery or predates the
     # poison: 2 (pre-poison), 4 and 6 (re-run); none from the window
     assert _committed_ckpt_steps(logdir) == [2, 4, 6]
+
+    # flight recorder (ISSUE 4): the divergence chain is captured in
+    # order — first bad observation, the refused save, the second bad
+    # observation, the restore, the rollback registration
+    interesting = ("nan_observed", "checkpoint_skipped", "rollback",
+                   "checkpoint_restore")
+    kinds = [k for k in _event_kinds(logdir) if k in interesting]
+    assert kinds == ["nan_observed", "checkpoint_skipped",
+                     "nan_observed", "checkpoint_restore",
+                     "rollback"], kinds
+    # metrics.jsonl stayed strict JSON through the non-finite window
+    # (the sanitization satellite): the poisoned rows read as null +
+    # raw repr, never bare NaN tokens
+    def reject(tok):
+        raise AssertionError(f"bare non-JSON token {tok!r}")
+
+    rows4 = [r for l in open(os.path.join(logdir, "metrics.jsonl"))
+             for r in [json.loads(l, parse_constant=reject)]
+             if r.get("step") == 4 and "total_loss" in r]
+    assert any(r["total_loss"] is None
+               and r["total_loss_raw_repr"] == "nan" for r in rows4), (
+        rows4)
+
+    # run_report renders the same incident from the artifacts (the
+    # acceptance post-mortem path)
+    from tools import run_report
+
+    report = run_report.render_report(logdir)
+    assert "| rollback |" in report
+    assert "non-finite scalar rows" in report
+    assert "### Segment 1" in report
 
 
 # ---- rungs 5-7: data-ingest faults (loader level, in-process) --------

@@ -1,0 +1,130 @@
+"""tools/run_report.py — markdown post-mortems from run artifacts.
+
+Tier-1 smoke (ISSUE 4 satellite): the report must render from the
+artifacts a short CPU dryrun leaves behind.  The artifacts here are
+produced by the REAL writers (MetricWriter + FlightRecorder), not
+hand-written JSON, so a contract drift between writer and reporter
+fails this file — without paying a model compile in tier-1 (the
+full-train rendering is asserted by the chaos rungs, which run
+run_report against an actual subprocess trainer's logdir).
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eksml_tpu import telemetry
+from eksml_tpu.utils.metrics import MetricWriter
+from tools import run_report
+
+
+def _dryrun_artifacts(logdir, steps=5):
+    """A 5-step CPU dryrun's logdir in miniature, via the real
+    writers: metrics rows (incl. one relaunch segment, cross-host
+    aggregates, a non-finite row), flight-recorder events, and an
+    attribution artifact."""
+    w = MetricWriter(logdir, enable_tensorboard=False,
+                     run_info={"config_digest": "cafe01"},
+                     publish_registry=False)
+    rec = telemetry.FlightRecorder(
+        path=telemetry.events_path_for(logdir, 0))
+    rec.record("run_start")
+    for step in range(1, steps + 1):
+        row = {"total_loss": 8.0 / step, "images_per_sec": 4.0,
+               "step_time_ms": 250.0 + step}
+        row.update(telemetry.stats_from_matrix(
+            [[250.0 + step, 0, 0, 0, 0, 0, 0],
+             [290.0, 1.5, 0, 1, 0, 0, 0]]))
+        w.write_scalars(step, row)
+    w.write_scalars(steps, {"checkpoint_save_ms": 120.0})
+    rec.record("checkpoint_save", step=steps, forced=False)
+    w.close()
+    # relaunch segment with a divergence incident
+    w2 = MetricWriter(logdir, enable_tensorboard=False,
+                      publish_registry=False)
+    w2.write_scalars(steps + 1, {"total_loss": float("nan")})
+    rec.record("nan_observed", step=steps + 1, loss="nan")
+    rec.record("rollback", step=steps + 1, to_step=steps)
+    rec.record("checkpoint_restore", step=steps)
+    w2.close()
+    rec.close()
+    os.makedirs(os.path.join(logdir, "profile"), exist_ok=True)
+    with open(os.path.join(logdir, "profile",
+                           "attribution.json"), "w") as f:
+        json.dump({"map": {}, "component_table": {
+            "component_pct": {"backbone": 41.5, "rpn": 12.0,
+                              "other": 9.0},
+            "other_pct": 9.0, "top_instructions": []}}, f)
+
+
+def test_report_renders_from_dryrun_artifacts(tmp_path):
+    logdir = str(tmp_path / "run")
+    _dryrun_artifacts(logdir)
+    report = run_report.render_report(logdir)
+    # segmentation: two run_start headers → two sections
+    assert "### Segment 1" in report and "### Segment 2" in report
+    assert "config_digest=`cafe01`" in report
+    assert "step 1 → 5" in report
+    # cross-host aggregation + straggler attribution surfaced
+    assert "host 1 lagged 5/5 intervals" in report
+    # the non-finite satellite round-trips into the report
+    assert "non-finite scalar rows: 1" in report
+    assert "total_loss=nan" in report
+    # the incident timeline shows the flight-recorder chain in order
+    assert "Incident timeline" in report
+    for kind in ("nan_observed", "rollback", "checkpoint_restore"):
+        assert f"| {kind} |" in report, kind
+    assert report.index("| nan_observed |") \
+        < report.index("| rollback |") \
+        < report.index("| checkpoint_restore |")
+    # attribution table rendered
+    assert "| backbone | 41.5 |" in report
+
+
+def test_report_cli_writes_file(tmp_path):
+    logdir = str(tmp_path / "run")
+    _dryrun_artifacts(logdir)
+    out = str(tmp_path / "report.md")
+    assert run_report.main([logdir, "--out", out]) == 0
+    assert "# Run report" in open(out).read()
+
+
+def test_report_degrades_on_missing_artifacts(tmp_path):
+    """A post-mortem tool must work on partial evidence: an empty
+    logdir renders notes, not a traceback."""
+    report = run_report.render_report(str(tmp_path))
+    assert "No metrics.jsonl found" in report
+    assert "No events-host*.jsonl found" in report
+    assert "No attribution artifact" in report
+
+
+def test_report_segments_headerless_legacy_logdir(tmp_path):
+    """Rows written before the run_start contract still render (one
+    synthetic segment)."""
+    logdir = str(tmp_path / "legacy")
+    os.makedirs(logdir)
+    with open(os.path.join(logdir, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({"step": 1, "total_loss": 2.0}) + "\n")
+        f.write("{torn-line\n")
+        f.write(json.dumps({"step": 2, "total_loss": 1.5}) + "\n")
+    report = run_report.render_report(logdir)
+    assert "### Segment 1" in report
+    assert "rows predate the run_start header contract" in report
+    assert "step 1 → 2" in report
+
+
+def test_max_events_caps_timeline(tmp_path):
+    logdir = str(tmp_path / "run")
+    os.makedirs(logdir)
+    rec = telemetry.FlightRecorder(
+        path=telemetry.events_path_for(logdir, 0))
+    for i in range(30):
+        rec.record("quarantine", step=i, image_id=i)
+    rec.close()
+    report = run_report.render_report(logdir, max_events=10)
+    assert "30 event(s) recorded; showing the last 10" in report
+    assert report.count("| quarantine |") == 10
+    assert "quarantine×30" in report

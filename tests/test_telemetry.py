@@ -1,0 +1,419 @@
+"""Unit half of the telemetry layer (ISSUE 4).
+
+Registry/exporter/aggregation/flight-recorder mechanics plus the
+MetricWriter satellites (TB-absent fallback, non-finite sanitization,
+run_start header).  The parser below is deliberately STRICT — it is
+the test suite's stand-in for a Prometheus scraper, shared with the
+chaos rungs (tests/test_fault_tolerance.py imports it), so any
+exposition-format regression fails here before a real scrape ever
+sees it.
+"""
+
+import json
+import math
+import os
+import re
+import sys
+import urllib.request
+
+import pytest
+
+from eksml_tpu import telemetry
+from eksml_tpu.telemetry.exporter import render_openmetrics
+from eksml_tpu.telemetry.registry import MetricRegistry
+
+# ---- strict OpenMetrics line parser (no new dependency) --------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram)$")
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(\{{[^{{}}]*\}})? "
+    r"([-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|NaN|[+-]Inf)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_openmetrics(text):
+    """Validate an OpenMetrics exposition; returns
+    {family: {"type": kind, "samples": {sample_line_name+labels: float}}}.
+    Raises AssertionError on any format violation."""
+    lines = text.split("\n")
+    assert lines[-1] == "", "exposition must end with a newline"
+    lines = lines[:-1]
+    assert lines, "empty exposition"
+    assert lines[-1] == "# EOF", "must terminate with # EOF"
+    assert lines.count("# EOF") == 1, "exactly one # EOF"
+    families = {}
+    current = None
+    for line in lines[:-1]:
+        m = _TYPE_RE.match(line)
+        if m:
+            name, kind = m.groups()
+            assert name not in families, f"duplicate TYPE for {name}"
+            families[name] = {"type": kind, "samples": {}}
+            current = name
+            continue
+        m = _HELP_RE.match(line)
+        if m:
+            assert m.group(1) == current, "HELP outside its family"
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        sample_name, labels, value = m.groups()
+        assert current is not None, "sample before any TYPE"
+        kind = families[current]["type"]
+        if kind == "counter":
+            assert sample_name == current + "_total", (
+                f"counter sample {sample_name!r} must end _total")
+        elif kind == "gauge":
+            assert sample_name == current, line
+        else:  # histogram
+            suffix = sample_name[len(current):]
+            assert suffix in ("_bucket", "_count", "_sum"), line
+            if suffix == "_bucket":
+                assert labels and "le=" in labels, (
+                    "bucket sample needs an le label")
+        if labels:
+            body = labels[1:-1]
+            parsed = _LABEL_RE.findall(body)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in parsed)
+            assert rebuilt == body, f"malformed labels: {labels!r}"
+        families[current]["samples"][sample_name + (labels or "")] = (
+            float(value))
+    # every histogram family carries the +Inf bucket and count/sum
+    for name, fam in families.items():
+        if fam["type"] == "histogram":
+            assert any('le="+Inf"' in k for k in fam["samples"]), name
+            assert any(k.startswith(name + "_count")
+                       for k in fam["samples"]), name
+            assert any(k.startswith(name + "_sum")
+                       for k in fam["samples"]), name
+    return families
+
+
+# ---- registry --------------------------------------------------------
+
+
+def test_registry_get_or_create_and_types():
+    r = MetricRegistry()
+    c = r.counter("eksml_x", "help")
+    assert r.counter("eksml_x") is c  # idempotent
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("eksml_g")
+    g.set(5)
+    g.inc(-2)
+    assert g.value == 3.0
+    g.set_function(lambda: 42.0)
+    assert g.value == 42.0
+    with pytest.raises(ValueError):
+        r.gauge("eksml_x")  # re-register under another type
+    with pytest.raises(ValueError):
+        r.counter("bad name!")
+    with pytest.raises(ValueError):
+        r.counter("eksml_l", labels={"bad-label": "v"})
+
+
+def test_registry_labeled_series_are_distinct():
+    r = MetricRegistry()
+    a = r.counter("eksml_q", labels={"kind": "decode"})
+    b = r.counter("eksml_q", labels={"kind": "missing"})
+    assert a is not b
+    a.inc(2)
+    b.inc()
+    assert r.get("eksml_q", labels={"kind": "decode"}).value == 2
+
+
+def test_histogram_buckets_cumulative_with_inf():
+    r = MetricRegistry()
+    h = r.histogram("eksml_h", buckets=(10, 100))
+    for v in (5, 50, 500, 7):
+        h.observe(v)
+    cum, total, count = h.snapshot()
+    assert cum == [2, 3, 4]  # ≤10, ≤100, +Inf — cumulative
+    assert count == 4 and total == 562
+
+
+# ---- exposition + exporter ------------------------------------------
+
+
+def _populated_registry():
+    r = MetricRegistry()
+    r.counter("eksml_resilience_rollbacks", "rollbacks").inc()
+    r.counter("eksml_data_quarantined_records", "by kind",
+              labels={"kind": "decode"}).inc(2)
+    r.gauge("eksml_hosts_step_time_ms_max", "aggregate").set(12.5)
+    r.gauge("eksml_weird", 'he"lp\nline').set(float("nan"))
+    h = r.histogram("eksml_train_step_time_ms", buckets=(10, 100))
+    h.observe(3)
+    h.observe(5000)
+    return r
+
+
+def test_render_openmetrics_is_strictly_parseable():
+    fams = parse_openmetrics(render_openmetrics(_populated_registry()))
+    assert fams["eksml_resilience_rollbacks"]["type"] == "counter"
+    assert fams["eksml_resilience_rollbacks"]["samples"][
+        "eksml_resilience_rollbacks_total"] == 1.0
+    assert fams["eksml_data_quarantined_records"]["samples"][
+        'eksml_data_quarantined_records_total{kind="decode"}'] == 2.0
+    assert fams["eksml_hosts_step_time_ms_max"]["samples"][
+        "eksml_hosts_step_time_ms_max"] == 12.5
+    assert math.isnan(fams["eksml_weird"]["samples"]["eksml_weird"])
+    hist = fams["eksml_train_step_time_ms"]["samples"]
+    assert hist['eksml_train_step_time_ms_bucket{le="+Inf"}'] == 2.0
+
+
+def test_exporter_scrape_and_healthz():
+    ex = telemetry.TelemetryExporter(
+        port=0, registry=_populated_registry(),
+        health_fn=lambda: {"step": 7}).start()
+    try:
+        assert ex.running and ex.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/metrics", timeout=10
+        ).read().decode()
+        parse_openmetrics(body)
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/healthz", timeout=10).read())
+        assert hz["status"] == "ok" and hz["step"] == 7
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ex.port}/nope", timeout=10)
+    finally:
+        ex.stop()
+    assert not ex.running
+
+
+def test_exporter_bind_conflict_is_nonfatal(tmp_path):
+    first = telemetry.TelemetryExporter(port=0).start()
+    try:
+        second = telemetry.TelemetryExporter(
+            port=first.port,
+            port_file=str(tmp_path / "port")).start()  # must not raise
+        assert not second.running and second.port is None
+        assert not (tmp_path / "port").exists()
+    finally:
+        first.stop()
+
+
+def test_exporter_writes_port_file(tmp_path):
+    pf = str(tmp_path / "telemetry-host0.port")
+    ex = telemetry.TelemetryExporter(port=0, port_file=pf).start()
+    try:
+        assert int(open(pf).read()) == ex.port
+    finally:
+        ex.stop()
+
+
+def test_tier1_scrape_includes_aggregates_and_resilience_counters():
+    """Tier-1 half of the acceptance scrape: the series the fit loop
+    pre-registers/publishes are present and strictly parseable before
+    any incident has occurred."""
+    from eksml_tpu.train import _preregister_core_metrics
+
+    r = MetricRegistry()
+    _preregister_core_metrics(r)
+    agg = telemetry.stats_from_matrix(
+        [[100.0, 1, 2, 0, 0, 0, 0], [140.0, 2, 3, 1, 0, 0, 0]])
+    telemetry.publish_aggregates(agg, r)
+    ex = telemetry.TelemetryExporter(port=0, registry=r).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/metrics", timeout=10
+        ).read().decode()
+    finally:
+        ex.stop()
+    fams = parse_openmetrics(body)
+    assert fams["eksml_hosts_step_time_ms_max"]["samples"][
+        "eksml_hosts_step_time_ms_max"] == 140.0
+    assert fams["eksml_hosts_lagging"]["samples"][
+        "eksml_hosts_lagging"] == 1.0
+    assert fams["eksml_resilience_rollbacks"]["samples"][
+        "eksml_resilience_rollbacks_total"] == 0.0
+    assert "eksml_data_quarantined_records" in fams
+
+
+# ---- cross-host aggregation -----------------------------------------
+
+
+def test_aggregate_single_process_identity():
+    agg = telemetry.aggregate_host_scalars(
+        {"step_time_ms": 123.0, "quarantined": 2.0})
+    assert agg["hosts/count"] == 1.0
+    for stat in ("min", "max", "mean"):
+        assert agg[f"hosts/step_time_ms_{stat}"] == 123.0
+        assert agg[f"hosts/quarantined_{stat}"] == 2.0
+    assert agg["hosts/lagging"] == 0.0
+    # unknown keys are ignored, missing keys default 0
+    assert agg["hosts/prefetch_wait_ms_max"] == 0.0
+
+
+def test_stats_from_matrix_straggler_attribution():
+    import numpy as np
+
+    k = len(telemetry.HOST_AGG_KEYS)
+    m = np.zeros((4, k))
+    m[:, 0] = [100, 90, 400, 95]  # host 2 is the straggler
+    m[:, 3] = [0, 5, 0, 0]        # quarantines on host 1
+    s = telemetry.stats_from_matrix(m)
+    assert s["hosts/lagging"] == 2.0
+    assert s["hosts/step_time_ms_max"] == 400.0
+    assert s["hosts/step_time_ms_min"] == 90.0
+    assert s["hosts/quarantined_max"] == 5.0
+    assert s["hosts/count"] == 4.0
+
+
+# ---- flight recorder -------------------------------------------------
+
+
+def test_flight_recorder_ring_mirror_and_report(tmp_path):
+    path = telemetry.events_path_for(str(tmp_path), 3)
+    assert path.endswith("events-host3.jsonl")
+    rec = telemetry.FlightRecorder(capacity=8, path=path, host_id=3)
+    for i in range(20):
+        rec.record("quarantine", step=i, image_id=i)
+    rec.record("rollback", step=20, to_step=16,
+               err=ValueError("x"))  # non-JSON field → repr
+    rec.close()
+    assert len(rec.tail()) == 8  # ring bounded
+    assert rec.tail(1)[0]["kind"] == "rollback"
+    assert rec.tail(1)[0]["err"] == repr(ValueError("x"))
+    # the mirror keeps EVERYTHING (the ring bounds memory, not disk)
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 21
+    assert all(l["host"] == 3 for l in lines)
+    report = rec.report(5)
+    assert "rollback" in report and "to_step=20" not in report
+    assert "step=20" in report
+
+
+def test_flight_recorder_nonfinite_field_survives(tmp_path):
+    """A NaN/Inf float field must take the repr() fallback, not blow
+    up the strict serialization and silently drop the event (the
+    incident rows are exactly where non-finite values appear)."""
+    path = telemetry.events_path_for(str(tmp_path), 0)
+    rec = telemetry.FlightRecorder(capacity=8, path=path)
+    entry = rec.record("nan_observed", step=3, loss=float("nan"))
+    rec.close()
+    assert entry is not None and entry["loss"] == "nan"
+    assert len(rec.tail()) == 1
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["loss"] == "nan"
+
+
+def test_event_module_api_and_install(tmp_path):
+    telemetry.install(None)
+    assert telemetry.event("nan_observed", step=1) is None  # no-op
+    rec = telemetry.FlightRecorder(capacity=8)
+    prev = telemetry.install(rec)
+    try:
+        assert prev is None
+        entry = telemetry.event("nan_observed", step=1, loss="nan")
+        assert entry["kind"] == "nan_observed"
+        assert telemetry.get() is rec
+        assert rec.tail(1)[0]["loss"] == "nan"
+    finally:
+        telemetry.install(None)
+
+
+def test_watchdog_report_carries_flight_recorder_tail(tmp_path):
+    """Acceptance: the hang report shows the events preceding the
+    stall (what happened BEFORE is usually the diagnosis)."""
+    from eksml_tpu.resilience.watchdog import HangWatchdog
+
+    rec = telemetry.FlightRecorder(capacity=8)
+    rec.record("checkpoint_restore", step=4)
+    rec.record("rollback", step=9, to_step=4)
+    wd = HangWatchdog(60.0, report_dir=str(tmp_path))
+    wd.add_report_provider("flight recorder", rec.report)
+    path = wd._dump("train_step", 10, 61.0)
+    text = open(path).read()
+    assert "--- flight recorder ---" in text
+    assert "rollback" in text and "checkpoint_restore" in text
+    assert text.index("flight recorder") < text.index("--- thread ")
+
+
+# ---- MetricWriter satellites ----------------------------------------
+
+
+def test_metric_writer_tb_backend_absent_fallback(tmp_path, monkeypatch):
+    """No flax/tensorboard backend → JSONL still works, no raise."""
+    import flax.metrics as fm
+
+    from eksml_tpu.utils.metrics import MetricWriter
+
+    monkeypatch.setitem(sys.modules, "flax.metrics.tensorboard", None)
+    monkeypatch.delattr(fm, "tensorboard", raising=False)
+    w = MetricWriter(str(tmp_path), enable_tensorboard=True,
+                     publish_registry=False)
+    assert w._tb is None
+    w.write_scalars(1, {"total_loss": 2.5})
+    w.close()
+    rows = [json.loads(l)
+            for l in open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+    assert rows[-1]["total_loss"] == 2.5
+
+
+def test_metric_writer_nonfinite_sanitization_roundtrip(tmp_path):
+    from eksml_tpu.utils.metrics import MetricWriter
+
+    w = MetricWriter(str(tmp_path), enable_tensorboard=False,
+                     publish_registry=False)
+    w.write_scalars(3, {"total_loss": float("nan"),
+                        "grad_norm": float("inf"),
+                        "learning_rate": 0.01})
+    w.close()
+    lines = open(os.path.join(str(tmp_path), "metrics.jsonl")
+                 ).read().splitlines()
+    # STRICT round trip: every line must be RFC-JSON (bare NaN/Infinity
+    # tokens — the bug this satellite fixes — fail parse_constant)
+    def reject(tok):
+        raise AssertionError(f"bare non-JSON token {tok!r} in stream")
+
+    rows = [json.loads(l, parse_constant=reject) for l in lines]
+    row = rows[-1]
+    assert row["total_loss"] is None
+    assert row["total_loss_raw_repr"] == "nan"
+    assert row["grad_norm"] is None
+    assert row["grad_norm_raw_repr"] == "inf"
+    assert row["learning_rate"] == 0.01
+
+
+def test_metric_writer_run_start_header(tmp_path):
+    from eksml_tpu.utils.metrics import MetricWriter
+
+    w = MetricWriter(str(tmp_path), enable_tensorboard=False,
+                     run_info={"config_digest": "abc123"},
+                     publish_registry=False)
+    w.write_scalars(1, {"total_loss": 1.0})
+    w.close()
+    # a second writer on the SAME logdir (preemption relaunch) appends
+    # its own header — the segmentation contract run_report.py uses
+    w2 = MetricWriter(str(tmp_path), enable_tensorboard=False,
+                      publish_registry=False)
+    w2.close()
+    rows = [json.loads(l)
+            for l in open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+    headers = [r for r in rows if r.get("event") == "run_start"]
+    assert len(headers) == 2
+    assert headers[0]["config_digest"] == "abc123"
+    for h in headers:
+        assert "argv" in h and "host_count" in h and "git_sha" in h
+    assert rows[0]["event"] == "run_start"  # header precedes scalars
+
+
+def test_metric_writer_mirrors_to_registry(tmp_path):
+    from eksml_tpu.utils.metrics import MetricWriter
+
+    w = MetricWriter(str(tmp_path), enable_tensorboard=False)
+    w.write_scalars(9, {"total_loss": 1.25, "data/queue_depth": 4})
+    w.close()
+    reg = telemetry.default_registry()
+    assert reg.get("eksml_train_total_loss").value == 1.25
+    assert reg.get("eksml_train_data_queue_depth").value == 4.0
+    assert reg.get("eksml_train_step").value == 9.0

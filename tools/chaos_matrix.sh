@@ -6,6 +6,10 @@
 # divergence sentinel / hang watchdog) or of the fault-tolerant data
 # ingest (eksml_tpu/data/robust.py: quarantine + substitution /
 # bounded I/O retry / decode-pool self-healing / starvation watchdog).
+# The proc-sigterm-graceful and proc-nan-rollback rungs additionally
+# assert the telemetry layer (eksml_tpu/telemetry/): the flight
+# recorder captured the incident chain in order, /metrics scraped as
+# valid OpenMetrics mid-run, and run_report.py renders the post-mortem.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # processes and are marked slow (excluded from tier-1); the unit and
 # data-* rungs run in seconds.  Everything runs under
@@ -29,6 +33,7 @@ RUNGS=(
   "unit-preemption|tests/test_resilience.py -k preemption"
   "unit-init-retry|tests/test_resilience.py tests/test_distributed.py -k 'retry or retries or exhaustion'"
   "unit-data-robust|tests/test_data_robust.py"
+  "unit-telemetry|tests/test_telemetry.py tests/test_run_report.py"
   "data-corrupt-jpeg|'tests/test_fault_tolerance.py::test_data_fault_rung[corrupt-jpeg]'"
   "data-missing-file|'tests/test_fault_tolerance.py::test_data_fault_rung[missing-file]'"
   "data-eio-recover|'tests/test_fault_tolerance.py::test_data_fault_rung[eio-recover]'"

@@ -1,0 +1,273 @@
+"""Render a markdown post-mortem from a run's telemetry artifacts.
+
+The artifacts one training logdir accumulates — ``metrics.jsonl``
+(run_start-segmented scalar rows, PR 4), ``events-host<i>.jsonl``
+(flight-recorder incident timeline), ``profile/attribution.json``
+(component cost table, PR 3) — answer "what happened to this run?",
+but only after hand-grepping three formats across N host files.  This
+tool folds them into one reviewable report:
+
+- **Run segments**: one section per ``run_start`` header (each
+  relaunch in a shared logdir is a segment) with argv, config digest,
+  git sha, steps covered, loss trajectory and throughput.
+- **Cross-host view**: the ``hosts/*`` aggregation columns
+  (min/max/mean step time, straggler index histogram) when present.
+- **Incident timeline**: every flight-recorder event across all hosts,
+  time-ordered — the SIGTERM → forced save → resumable exit chain, a
+  NaN streak → rollback → restore chain, quarantines, pool rebuilds,
+  watchdog dumps.
+- **Non-finite observations**: rows whose scalars were sanitized to
+  ``null`` (the ``*_raw_repr`` satellite), i.e. exactly where the loss
+  went bad.
+- **Modeled cost**: the attribution component table, when the run
+  banked a profile.
+
+Usage::
+
+    python tools/run_report.py <logdir> [--out report.md]
+                               [--max-events 100]
+
+Missing artifacts degrade to a note, never an error — a post-mortem
+tool must work on partial evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def _read_jsonl(path: str) -> List[Dict]:
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn write from a killed process
+    return rows
+
+
+def load_metrics(logdir: str) -> List[List[Dict]]:
+    """metrics.jsonl → list of segments, split at run_start headers.
+    Rows before the first header (a pre-PR-4 logdir) form segment 0
+    with a synthetic header."""
+    rows = _read_jsonl(os.path.join(logdir, "metrics.jsonl"))
+    segments: List[List[Dict]] = []
+    for row in rows:
+        if row.get("event") == "run_start" or not segments:
+            if row.get("event") != "run_start":
+                segments.append([{"event": "run_start",
+                                  "synthetic": True}])
+                segments[-1].append(row)
+                continue
+            segments.append([row])
+        else:
+            segments[-1].append(row)
+    return segments
+
+
+def load_events(logdir: str) -> List[Dict]:
+    events = []
+    for path in sorted(glob.glob(
+            os.path.join(logdir, "events-host*.jsonl"))):
+        events.extend(_read_jsonl(path))
+    events.sort(key=lambda e: e.get("time", 0.0))
+    return events
+
+
+def _ts(t: Optional[float]) -> str:
+    if not t:
+        return "-"
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
+
+
+def _fmt_num(v, digits=4) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def _segment_section(i: int, seg: List[Dict]) -> List[str]:
+    header, rows = seg[0], [r for r in seg[1:] if "step" in r]
+    lines = [f"### Segment {i + 1} — started {_ts(header.get('time'))}"]
+    meta = []
+    if header.get("synthetic"):
+        meta.append("(rows predate the run_start header contract)")
+    for key in ("git_sha", "config_digest", "host_count", "pid"):
+        if key in header:
+            meta.append(f"{key}=`{header[key]}`")
+    if header.get("argv"):
+        meta.append("argv=`" + " ".join(header["argv"]) + "`")
+    if meta:
+        lines.append("")
+        lines.append("- " + "\n- ".join(meta))
+    loss_rows = [r for r in rows if "total_loss" in r]
+    if not loss_rows:
+        lines.append("")
+        lines.append("No training steps logged in this segment.")
+        return lines
+    steps = [r["step"] for r in loss_rows]
+    finite = [r["total_loss"] for r in loss_rows
+              if isinstance(r["total_loss"], (int, float))]
+    ips = [r["images_per_sec"] for r in loss_rows
+           if isinstance(r.get("images_per_sec"), (int, float))]
+    lines += [
+        "",
+        f"- steps logged: {len(loss_rows)} "
+        f"(step {min(steps)} → {max(steps)})",
+        f"- total_loss: first {_fmt_num(loss_rows[0]['total_loss'])}, "
+        f"last {_fmt_num(loss_rows[-1]['total_loss'])}"
+        + (f", min {_fmt_num(min(finite))}" if finite else ""),
+    ]
+    if ips:
+        lines.append(f"- images/sec: mean {_fmt_num(sum(ips)/len(ips))},"
+                     f" last {_fmt_num(ips[-1])}")
+    ckpt = [r for r in rows if "checkpoint_save_ms" in r
+            and isinstance(r["checkpoint_save_ms"], (int, float))]
+    if ckpt:
+        lines.append(
+            f"- checkpoint saves logged: {len(ckpt)} (last "
+            f"{_fmt_num(ckpt[-1]['checkpoint_save_ms'], 5)} ms)")
+    bad = [r for r in loss_rows if any(k.endswith("_raw_repr")
+                                       for k in r)]
+    if bad:
+        items = ", ".join(
+            f"step {r['step']}: "
+            + "; ".join(f"{k[:-len('_raw_repr')]}={r[k]}"
+                        for k in sorted(r) if k.endswith("_raw_repr"))
+            for r in bad[:10])
+        lines.append(f"- **non-finite scalar rows: {len(bad)}** "
+                     f"({items}{', …' if len(bad) > 10 else ''})")
+    agg = [r for r in loss_rows if "hosts/step_time_ms_max" in r]
+    if agg:
+        last = agg[-1]
+        lines.append(
+            "- cross-host (last interval): step_time_ms "
+            f"min {_fmt_num(last.get('hosts/step_time_ms_min'))} / "
+            f"mean {_fmt_num(last.get('hosts/step_time_ms_mean'))} / "
+            f"max {_fmt_num(last.get('hosts/step_time_ms_max'))} over "
+            f"{int(last.get('hosts/count', 1))} host(s)")
+        lag: Dict[int, int] = {}
+        for r in agg:
+            lag[int(r.get("hosts/lagging", 0))] = lag.get(
+                int(r.get("hosts/lagging", 0)), 0) + 1
+        ranked = sorted(lag.items(), key=lambda kv: -kv[1])
+        lines.append(
+            "- straggler attribution: "
+            + ", ".join(f"host {h} lagged {n}/{len(agg)} intervals"
+                        for h, n in ranked[:3]))
+    return lines
+
+
+def _events_section(events: List[Dict], max_events: int) -> List[str]:
+    lines = ["## Incident timeline (flight recorder)"]
+    if not events:
+        lines.append("")
+        lines.append("No events-host*.jsonl found — either the run "
+                     "predates the flight recorder or nothing "
+                     "noteworthy happened.")
+        return lines
+    shown = events[-max_events:]
+    lines += ["",
+              f"{len(events)} event(s) recorded"
+              + (f"; showing the last {len(shown)}"
+                 if len(shown) < len(events) else "") + ":",
+              "",
+              "| time | host | kind | step | detail |",
+              "|---|---|---|---|---|"]
+    for e in shown:
+        detail = ", ".join(
+            f"{k}={e[k]}" for k in sorted(e)
+            if k not in ("time", "host", "kind", "step"))
+        lines.append(
+            f"| {_ts(e.get('time'))} | {e.get('host', '-')} "
+            f"| {e.get('kind', '?')} | {e.get('step', '-')} "
+            f"| {detail or '-'} |")
+    counts: Dict[str, int] = {}
+    for e in events:
+        counts[e.get("kind", "?")] = counts.get(e.get("kind", "?"), 0) + 1
+    lines += ["",
+              "By kind: " + ", ".join(
+                  f"{k}×{n}" for k, n in sorted(counts.items(),
+                                                key=lambda kv: -kv[1]))]
+    return lines
+
+
+def _attribution_section(logdir: str,
+                         attribution: Optional[str]) -> List[str]:
+    path = attribution or os.path.join(logdir, "profile",
+                                       "attribution.json")
+    lines = ["## Modeled cost by component (profile attribution)"]
+    if not os.path.exists(path):
+        lines += ["", f"No attribution artifact at `{path}` — run "
+                      "`bench.py --profile` to bank one."]
+        return lines
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        table = payload["component_table"]["component_pct"]
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        lines += ["", f"Could not parse `{path}`: {e!r}"]
+        return lines
+    lines += ["", "| component | modeled % |", "|---|---|"]
+    for comp, pct in table.items():
+        lines.append(f"| {comp} | {pct} |")
+    return lines
+
+
+def render_report(logdir: str, attribution: Optional[str] = None,
+                  max_events: int = 100) -> str:
+    segments = load_metrics(logdir)
+    events = load_events(logdir)
+    lines = [f"# Run report — `{logdir}`", "",
+             f"Generated {_ts(time.time())} by tools/run_report.py.",
+             "", "## Run segments"]
+    if not segments:
+        lines += ["", "No metrics.jsonl found — nothing was logged "
+                      "(or the logdir path is wrong)."]
+    for i, seg in enumerate(segments):
+        lines.append("")
+        lines.extend(_segment_section(i, seg))
+    lines.append("")
+    lines.extend(_events_section(events, max_events))
+    lines.append("")
+    lines.extend(_attribution_section(logdir, attribution))
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("logdir", help="training run directory")
+    p.add_argument("--out", default=None,
+                   help="write the report here (default: stdout)")
+    p.add_argument("--attribution", default=None,
+                   help="attribution.json path (default: "
+                        "<logdir>/profile/attribution.json)")
+    p.add_argument("--max-events", type=int, default=100,
+                   help="cap on timeline rows (newest kept)")
+    args = p.parse_args(argv)
+
+    report = render_report(args.logdir, attribution=args.attribution,
+                           max_events=args.max_events)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
