@@ -196,11 +196,54 @@ RESILIENCE_DATA_DEFAULTS = dict(
 #   columns).
 # - FLIGHT_RECORDER_EVENTS: in-memory ring capacity; events also
 #   mirror to <logdir>/events-host<i>.jsonl (telemetry/recorder.py).
+# - HEALTHZ_STALE_SEC: liveness semantics for /healthz — once the
+#   reported seconds_since_last_step exceeds this bound the endpoint
+#   answers 503 "stale" so a k8s livenessProbe restarts the wedged
+#   pod.  0 = legacy always-200.  Size it to cover the first-step XLA
+#   compile (minutes), not just steady-state steps — the charts'
+#   probe initialDelay rides the same value.
 TELEMETRY_DEFAULTS = dict(
     ENABLED=True,
     PORT=9090,
     AGGREGATE_HOSTS=True,
     FLIGHT_RECORDER_EVENTS=256,
+    HEALTHZ_STALE_SEC=0.0,
+)
+
+# Span tracing + on-demand profiling knobs (telemetry/tracing.py),
+# installed under TELEMETRY.TRACING; train._tracing_knobs imports the
+# same dict as the fallback for pre-tracing config trees.
+#
+# - ENABLED: install the per-host span tracer (context-manager spans
+#   through the hot path → bounded ring → Chrome-trace JSON at
+#   <logdir>/trace-host<i>.json).  Off = the span API is a true no-op
+#   (shared null context manager, no allocation).
+# - RING_EVENTS: span ring capacity (memory bound; oldest spans drop).
+# - PROFILE_STEPS: steps per on-demand/anomaly capture when the
+#   /debugz/profile request doesn't name its own count.
+# - PROFILE_COOLDOWN_SEC / MAX_CAPTURES_PER_RUN: the ProfileTrigger
+#   guard rails — a flapping alert or curious operator cannot chain
+#   captures back to back or fill the shared fs with trace dumps.
+# - ANOMALY_TRIGGER: fire the same capture automatically when the
+#   detector below sees a persistent anomaly (the incident's trace
+#   exists before anyone is paged).
+# - ANOMALY_INTERVALS: consecutive anomalous log intervals required
+#   (one blip is noise; K in a row is an incident).
+# - ANOMALY_P95_FACTOR: interval step time > factor × rolling p95 of
+#   healthy intervals = anomalous.
+# - ANOMALY_SPREAD_FACTOR: hosts/step_time_ms max/mean ratio gate for
+#   the persistent-straggler signal (argmax over near-identical hosts
+#   is a random index without it).
+TELEMETRY_TRACING_DEFAULTS = dict(
+    ENABLED=False,
+    RING_EVENTS=4096,
+    PROFILE_STEPS=3,
+    PROFILE_COOLDOWN_SEC=300.0,
+    MAX_CAPTURES_PER_RUN=3,
+    ANOMALY_TRIGGER=True,
+    ANOMALY_INTERVALS=3,
+    ANOMALY_P95_FACTOR=1.5,
+    ANOMALY_SPREAD_FACTOR=1.5,
 )
 
 
@@ -422,6 +465,9 @@ def _define_defaults() -> None:
     # flight recorder; per-knob docs on TELEMETRY_DEFAULTS above.
     for k, v in TELEMETRY_DEFAULTS.items():
         setattr(_C.TELEMETRY, k, v)
+    # span tracing + on-demand profiling (telemetry/tracing.py)
+    for k, v in TELEMETRY_TRACING_DEFAULTS.items():
+        setattr(_C.TELEMETRY.TRACING, k, v)
 
     _C.freeze()
 

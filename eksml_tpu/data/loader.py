@@ -642,6 +642,7 @@ class DetectionLoader:
                 while not stop.is_set() and (num_steps is None
                                              or produced < num_steps):
                     t_build = time.monotonic()
+                    t_span = time.perf_counter()
                     self._heal_proc_pool()  # no-op unless a break is pending
                     pad_hw, idx = self._next_bucket_batch()
                     recs = [self.records[i] for i in idx]
@@ -686,6 +687,14 @@ class DetectionLoader:
                              for k in exs[0].keys()}
                     self.health.record_batch(
                         (time.monotonic() - t_build) * 1000)
+                    # producer-lane span (no step: the producer runs
+                    # ahead of the step counter; seq joins batches in
+                    # the timeline).  Recorded BEFORE the queue put —
+                    # blocking on a full queue is healthy back-
+                    # pressure, not build time.
+                    telemetry.complete_span("batch_build", t_span,
+                                            time.perf_counter(),
+                                            seq=produced)
                     if not put_or_stop(batch):
                         return
                     produced += 1
@@ -817,10 +826,19 @@ class DevicePrefetcher:
 
     def _produce(self, it) -> None:
         try:
+            seq = 0
             for host_batch in it:
                 if self._stop.is_set():
                     return
-                if not self._put(self._transfer(host_batch)):
+                t0 = time.perf_counter()
+                item = self._transfer(host_batch)
+                # transfer-lane span: the H2D copy overlapping (or
+                # not) the device's current step is the whole point
+                # of the prefetcher — now visible in the timeline
+                telemetry.complete_span("h2d_prefetch", t0,
+                                        time.perf_counter(), seq=seq)
+                seq += 1
+                if not self._put(item):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised in next()
             self._error.append(e)

@@ -27,7 +27,6 @@ import os
 import sys
 import threading
 import time
-import traceback
 from typing import Callable, Optional
 
 log = logging.getLogger(__name__)
@@ -184,18 +183,12 @@ class HangWatchdog:
             except Exception as e:  # noqa: BLE001 — report must land
                 lines.append(f"<report provider failed: {e!r}>")
             lines.append("")
-        frames = sys._current_frames()
-        threads = {t.ident: t for t in threading.enumerate()}
-        for ident, frame in frames.items():
-            t = threads.get(ident)
-            name = t.name if t else f"unknown-{ident}"
-            daemon = getattr(t, "daemon", "?")
-            lines.append(f"--- thread {name} (ident={ident}, "
-                         f"daemon={daemon}) ---")
-            lines.extend(
-                l.rstrip("\n")
-                for l in traceback.format_stack(frame))
-            lines.append("")
+        # ONE stack-dump implementation, shared with the exporter's
+        # /debugz/stacks endpoint (lazy import: tracing is stdlib-only
+        # but the telemetry package pulls in the full layer)
+        from eksml_tpu.telemetry.tracing import format_thread_stacks
+
+        lines.extend(format_thread_stacks().splitlines())
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
         return path

@@ -12,8 +12,17 @@ The observability layer (ISSUE 4).  Data flow::
         events-host<i>.jsonl + watchdog report tail +
         tools/run_report.py post-mortems
 
-Config knobs live under ``config.TELEMETRY``; chart plumbing
-(prometheus.io/scrape annotations, container port) in
+Span tracing (ISSUE 5) rides the same flow: subsystems time hot-path
+intervals through the module-level ``span()`` (no-op without an
+installed :class:`~eksml_tpu.telemetry.tracing.Tracer`), the ring
+flushes Chrome-trace JSON to ``<logdir>/trace-host<i>.json``, and the
+exporter's ``/debugz/profile`` endpoint (or the anomaly detector)
+asks the fit loop for a bounded ``jax.profiler`` capture through a
+:class:`~eksml_tpu.telemetry.tracing.ProfileTrigger`.
+
+Config knobs live under ``config.TELEMETRY`` (tracing under
+``config.TELEMETRY.TRACING``); chart plumbing (prometheus.io/scrape
+annotations, container port, liveness probe) in
 charts/maskrcnn*/templates.
 """
 
@@ -28,3 +37,8 @@ from eksml_tpu.telemetry.recorder import (FlightRecorder,  # noqa: F401
                                           install)
 from eksml_tpu.telemetry.registry import (MetricRegistry,  # noqa: F401
                                           default_registry)
+from eksml_tpu.telemetry.tracing import (AnomalyDetector,  # noqa: F401
+                                         ProfileTrigger, Tracer,
+                                         complete_span, get_tracer,
+                                         install_tracer, span,
+                                         trace_path_for, traced)

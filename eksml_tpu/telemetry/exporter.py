@@ -1,4 +1,5 @@
-"""Dependency-free OpenMetrics HTTP exporter (+ ``/healthz``).
+"""Dependency-free OpenMetrics HTTP exporter (+ ``/healthz``,
+``/debugz``).
 
 The reference's only metric surface is a TensorBoard side-service
 scraping rank-0's event files off the shared filesystem — per-host
@@ -12,7 +13,19 @@ machine-scrapeable (SURVEY.md §5.5).  This serves the process-local
   with the ``+Inf`` bound, terminating ``# EOF``).
 - ``GET /healthz`` — JSON liveness with process uptime plus whatever
   the installable ``health_fn`` reports (the fit loop wires last-step
-  info), for the pod's HTTP probes.
+  info), for the pod's HTTP probes.  With ``stale_after_sec > 0`` it
+  has real LIVENESS semantics: when the reported
+  ``seconds_since_last_step`` exceeds the bound the status flips to
+  503/"stale", so a k8s livenessProbe restarts a wedged pod instead
+  of reading an eternally-green 200 (the charts render the probe from
+  the same ``healthz_stale_seconds`` value).
+- ``GET /debugz/profile?steps=N`` — request a bounded on-demand
+  profiler capture (``jax.profiler`` trace + span-ring flush) through
+  the installed :class:`~eksml_tpu.telemetry.tracing.ProfileTrigger`;
+  the fit loop executes it at the next step boundary.  Cooldown /
+  max-captures rejections return 429 with the reason.
+- ``GET /debugz/stacks`` — all-thread stack dump (text/plain), the
+  hang watchdog's report section served on demand.
 
 The charts annotate the training pods with ``prometheus.io/scrape``
 (see charts/maskrcnn/templates/maskrcnn.yaml), so any standard
@@ -37,6 +50,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs
 
 from eksml_tpu.telemetry.registry import (COUNTER, GAUGE, HISTOGRAM,
                                           MetricRegistry,
@@ -108,8 +122,16 @@ class _Handler(BaseHTTPRequestHandler):
     # set by the exporter on the handler class it instantiates
     exporter: "TelemetryExporter"
 
+    def _send_json(self, code: int, payload: Dict) -> None:
+        body = (json.dumps(payload) + "\n").encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             try:
                 body = render_openmetrics(
@@ -134,9 +156,46 @@ class _Handler(BaseHTTPRequestHandler):
                     payload.update(fn())
                 except Exception:  # noqa: BLE001 — health stays up
                     payload["health_fn_error"] = True
-            body = (json.dumps(payload) + "\n").encode("utf-8")
+            # liveness semantics: past the staleness bound the probe
+            # must see a FAILURE code — a wedged step loop behind an
+            # eternally-200 healthz is exactly the silent hang the
+            # bound exists to catch
+            code = 200
+            bound = self.exporter.stale_after_sec
+            since = payload.get("seconds_since_last_step")
+            if (bound and bound > 0 and isinstance(since, (int, float))
+                    and since > bound):
+                payload["status"] = "stale"
+                payload["stale_after_sec"] = bound
+                code = 503
+            self._send_json(code, payload)
+        elif path == "/debugz/profile":
+            trigger = self.exporter.profile_trigger
+            if trigger is None:
+                self._send_json(503, {
+                    "status": "unavailable",
+                    "detail": "no profile trigger installed (is a "
+                              "fit loop running?)"})
+                return
+            params = parse_qs(query)
+            steps = (params.get("steps", [None])[0])
+            ok, detail = trigger.request(steps=steps, reason="debugz")
+            payload = {"status": "accepted" if ok else "rejected",
+                       "detail": detail}
+            payload.update(trigger.status())
+            self._send_json(200 if ok else 429, payload)
+        elif path == "/debugz/stacks":
+            from eksml_tpu.telemetry.tracing import format_thread_stacks
+
+            try:
+                body = format_thread_stacks().encode("utf-8")
+            except Exception:  # noqa: BLE001 — debug must not 500
+                log.exception("stack dump failed")
+                self.send_error(500)
+                return
             self.send_response(200)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type",
+                             "text/plain; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -153,9 +212,17 @@ class TelemetryExporter:
     def __init__(self, port: int = 9090, addr: str = "0.0.0.0",
                  registry: Optional[MetricRegistry] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
-                 port_file: Optional[str] = None):
+                 port_file: Optional[str] = None,
+                 profile_trigger=None,
+                 stale_after_sec: float = 0.0):
         self.registry = registry or default_registry()
         self.health_fn = health_fn
+        # ProfileTrigger (telemetry/tracing.py) serving /debugz/profile;
+        # None = the endpoint answers 503 "unavailable"
+        self.profile_trigger = profile_trigger
+        # /healthz returns 503 once health_fn's seconds_since_last_step
+        # exceeds this bound (0 = legacy always-200 behavior)
+        self.stale_after_sec = float(stale_after_sec or 0.0)
         self.requested_port = int(port)
         self.addr = addr
         self.port_file = port_file
@@ -176,6 +243,16 @@ class TelemetryExporter:
             # one node) only the first process wins the fixed port
             log.warning("telemetry exporter disabled: cannot bind "
                         "%s:%d (%s)", self.addr, self.requested_port, e)
+            if self.stale_after_sec > 0:
+                # a chart-rendered livenessProbe is now probing a dead
+                # port: connection refused counts as a probe failure
+                # and kubelet will restart the pod — escalate so the
+                # pod log names the cause before the restart loop does
+                log.error(
+                    "a /healthz liveness bound is configured "
+                    "(stale_after_sec=%s) but the exporter could not "
+                    "bind — any livenessProbe on this port will fail "
+                    "and restart the pod", self.stale_after_sec)
             return self
         server.daemon_threads = True
         self._server = server
@@ -197,8 +274,8 @@ class TelemetryExporter:
             except OSError:
                 log.warning("could not write telemetry port file %s",
                             self.port_file)
-        log.info("telemetry exporter serving /metrics and /healthz "
-                 "on port %d", self.port)
+        log.info("telemetry exporter serving /metrics, /healthz and "
+                 "/debugz on port %d", self.port)
         return self
 
     @property

@@ -134,21 +134,33 @@ def make_optimizer(cfg):
     return optax.chain(*chain), sched
 
 
-def _telemetry_knobs(cfg) -> Dict[str, Any]:
-    """TELEMETRY values with fallbacks for callers that hand the
-    trainer a config tree predating the telemetry knobs (same pattern
-    as the loader's ``_data_knobs``) — defaults are the canonical
-    ``TELEMETRY_DEFAULTS`` (one source of truth)."""
-    from eksml_tpu.config import TELEMETRY_DEFAULTS
-
-    out = dict(TELEMETRY_DEFAULTS)
-    node = getattr(cfg, "TELEMETRY", None)
+def _knobs_with_fallback(node, defaults: Dict[str, Any]) -> Dict[str, Any]:
+    """Config-node values over canonical defaults, for callers that
+    hand the trainer a config tree predating the knobs (same pattern
+    as the loader's ``_data_knobs``) — the defaults dict stays the one
+    source of truth; sub-trees (``to_dict``) never shadow a scalar."""
+    out = dict(defaults)
     if node is not None:
         for k in out:
             v = getattr(node, k, None)
             if v is not None and not hasattr(v, "to_dict"):
                 out[k] = v
     return out
+
+
+def _telemetry_knobs(cfg) -> Dict[str, Any]:
+    from eksml_tpu.config import TELEMETRY_DEFAULTS
+
+    return _knobs_with_fallback(getattr(cfg, "TELEMETRY", None),
+                                TELEMETRY_DEFAULTS)
+
+
+def _tracing_knobs(cfg) -> Dict[str, Any]:
+    from eksml_tpu.config import TELEMETRY_TRACING_DEFAULTS
+
+    return _knobs_with_fallback(
+        getattr(getattr(cfg, "TELEMETRY", None), "TRACING", None),
+        TELEMETRY_TRACING_DEFAULTS)
 
 
 def cast_params_for_storage(params, param_dtype: str):
@@ -256,11 +268,13 @@ class Trainer:
         # Trainer that never touches the run's metrics.jsonl/TB events
         # (or its flight-recorder event files)
         self._telemetry = _telemetry_knobs(cfg)
+        self._tracing = _tracing_knobs(cfg)
         run_info = {"config_digest": _config_digest(cfg)}
         self.writer = (MetricWriter(logdir, run_info=run_info)
                        if write_metrics and jax.process_index() == 0
                        else None)
         self.recorder = None
+        self.tracer = None
         if write_metrics and self._telemetry["ENABLED"]:
             # one flight recorder per HOST (unlike the rank-0 writer):
             # resilience incidents are per-host facts
@@ -274,6 +288,18 @@ class Trainer:
             self.recorder = telemetry.get()
             telemetry.event("run_start", pid=os.getpid(),
                             host_count=jax.process_count(), **run_info)
+            if self._tracing["ENABLED"]:
+                # span tracer, also per HOST: the whole point is the
+                # cross-host timeline (trace-host<i>.json per host,
+                # merged by tools/trace_summary.py --merge)
+                prev_t = telemetry.install_tracer(telemetry.Tracer(
+                    capacity=int(self._tracing["RING_EVENTS"]),
+                    path=telemetry.trace_path_for(
+                        logdir, jax.process_index()),
+                    host_id=jax.process_index()))
+                if prev_t is not None:
+                    prev_t.flush()
+                self.tracer = telemetry.get_tracer()
         self.ckpt = CheckpointManager(
             logdir, digest=cfg.RESILIENCE.CHECKPOINT_DIGEST)
 
@@ -404,6 +430,12 @@ class Trainer:
         many post-compile steps into ``<logdir>/profile`` (the
         one-command perf-visibility path, SURVEY.md §5.1 — the
         reference's only analogue is NCCL_DEBUG=INFO ring dumps).
+        The same executor also serves ``GET /debugz/profile?steps=N``
+        on the telemetry port and the anomaly trigger
+        (``TELEMETRY.TRACING.*`` knobs): both ask through a
+        cooldown-guarded ProfileTrigger, captures land at step
+        boundaries, and with tracing enabled the span ring flushes to
+        ``<logdir>/trace-host<i>.json`` alongside the profiler trace.
 
         Resilience wiring (eksml_tpu/resilience/, knobs under
         ``config.RESILIENCE``): SIGTERM forces a checkpoint at the next
@@ -422,7 +454,7 @@ class Trainer:
         cfg = self.cfg
         res = cfg.RESILIENCE
         step_fn = None
-        profile_until = None
+        capture = None  # in-flight profiler capture (dict) or None
         t_last = time.time()
         steps_since_log = 0
         steps_per_epoch = cfg.TRAIN.STEPS_PER_EPOCH
@@ -460,7 +492,54 @@ class Trainer:
         if data_health is not None:
             data_health.register_gauges(registry)
         health_state = {"step": start_step, "total_steps": total_steps}
+        # monotonic PROGRESS clock for /healthz liveness: the probe
+        # reads seconds_since_last_step and (past the
+        # HEALTHZ_STALE_SEC bound) a 503 — a wedged collective behind
+        # an always-200 healthz is the silent hang k8s cannot see.
+        # Every documented long-but-legitimate phase beats it too
+        # (restore, checkpoint save, eval, rollback) so the probe
+        # kills wedged pods, not pods mid-eval; the bound must still
+        # cover the LONGEST single phase (first-step compile, one
+        # eval pass) — the charts' probe initialDelay rides the same
+        # value
+        health_clock = {"last_step": time.monotonic()}
+
+        def _progress() -> None:
+            health_clock["last_step"] = time.monotonic()
+
+        def _health() -> Dict[str, Any]:
+            out = dict(health_state)
+            out["seconds_since_last_step"] = round(
+                time.monotonic() - health_clock["last_step"], 1)
+            return out
+
         exporter = None
+        # on-demand profiler captures (telemetry/tracing.py): ONE
+        # trigger shared by /debugz/profile, the anomaly detector and
+        # (via the same executor below) the --profile CLI flag
+        profile_trigger = None
+        detector = None
+        if self._telemetry["ENABLED"]:
+            profile_trigger = telemetry.ProfileTrigger(
+                cooldown_sec=float(
+                    self._tracing["PROFILE_COOLDOWN_SEC"]),
+                max_captures=int(
+                    self._tracing["MAX_CAPTURES_PER_RUN"]),
+                default_steps=int(self._tracing["PROFILE_STEPS"]))
+            # auto-captures ride the tracing knob: with TRACING
+            # disabled (the shipped chart default) a sustained
+            # slowdown must NOT surprise the operator with profiler
+            # overhead + trace dumps they believed were switched off —
+            # only the explicit /debugz request stays available
+            if (self._tracing["ENABLED"]
+                    and self._tracing["ANOMALY_TRIGGER"]):
+                detector = telemetry.AnomalyDetector(
+                    k_intervals=int(
+                        self._tracing["ANOMALY_INTERVALS"]),
+                    p95_factor=float(
+                        self._tracing["ANOMALY_P95_FACTOR"]),
+                    spread_factor=float(
+                        self._tracing["ANOMALY_SPREAD_FACTOR"]))
         # ENABLED is the master switch for the whole layer: without it
         # neither the exporter NOR the aggregation collective runs
         aggregate_hosts = bool(self._telemetry["ENABLED"]
@@ -492,6 +571,11 @@ class Trainer:
             source = prefetcher
 
         step = start_step
+        if self.tracer is not None:
+            # (re)install for THIS fit — a second fit() on the same
+            # Trainer must trace too, and the finally below uninstalls
+            # so a finished run's tracer can't swallow later spans
+            telemetry.install_tracer(self.tracer)
         try:
             # exporter starts INSIDE the try so any setup failure
             # below still reaches the finally that stops it — a leaked
@@ -500,18 +584,54 @@ class Trainer:
             if self._telemetry["ENABLED"]:
                 exporter = telemetry.TelemetryExporter(
                     port=int(self._telemetry["PORT"]),
-                    health_fn=lambda: dict(health_state),
+                    health_fn=_health,
                     port_file=os.path.join(
                         self.logdir,
                         f"telemetry-host{jax.process_index()}.port"),
+                    profile_trigger=profile_trigger,
+                    stale_after_sec=float(
+                        self._telemetry["HEALTHZ_STALE_SEC"]),
                 ).start()
-            for batch in source:
+            elif float(self._telemetry["HEALTHZ_STALE_SEC"]) > 0:
+                # the charts render a livenessProbe whenever
+                # healthz_stale_seconds > 0 — with telemetry disabled
+                # nothing serves /healthz, every probe gets connection
+                # refused, and kubelet restarts a HEALTHY pod forever.
+                # The combination is an operator error; say so loudly.
+                log.warning(
+                    "TELEMETRY.HEALTHZ_STALE_SEC=%s is set but "
+                    "TELEMETRY.ENABLED=False: /healthz will NOT be "
+                    "served — if the chart rendered a livenessProbe "
+                    "(healthz_stale_seconds > 0) kubelet will restart "
+                    "this pod in a loop. Set healthz_stale_seconds=0 "
+                    "when disabling telemetry.",
+                    self._telemetry["HEALTHZ_STALE_SEC"])
+            source_iter = iter(source)
+            _end = object()
+            while True:
+                # data_wait: how long the step loop blocked on input —
+                # the span that names a starving TPU in the timeline.
+                # Input spans are tagged with the step they FEED
+                # (step+1), so every span of one loop iteration joins
+                # the train_step it produced — a step stalled on input
+                # shows ITS OWN data_wait as the dominant span, not
+                # the previous step's.  Until restore_or_init has run,
+                # the feeding step is unknown (a resume jumps `step`
+                # to the checkpoint) — an untagged span beats one
+                # joined to the wrong train_step.
+                feeds = step + 1 if state is not None else None
+                with telemetry.span("data_wait", step=feeds):
+                    batch = next(source_iter, _end)
+                if batch is _end:
+                    break
                 if watchdog:
                     watchdog.beat("globalize_batch", step)
-                device_batch = (batch if prefetcher is not None
-                                else self._globalize_batch(batch))
+                with telemetry.span("globalize_batch", step=feeds):
+                    device_batch = (batch if prefetcher is not None
+                                    else self._globalize_batch(batch))
                 if state is None:
                     state, step = self.restore_or_init(device_batch)
+                    _progress()  # a multi-GB restore is not a hang
                     if step >= total_steps:
                         break
                 first_call = step_fn is None
@@ -519,7 +639,11 @@ class Trainer:
                     step_fn = self.compiled_step()
                 if watchdog:
                     watchdog.beat("train_step", step + 1)
-                state, metrics = step_fn(state, device_batch)
+                # host-side dispatch of the compiled step (the device
+                # executes async; blocking shows up in data_wait /
+                # host_metrics instead — the Dapper-style host timeline)
+                with telemetry.span("train_step", step=step + 1):
+                    state, metrics = step_fn(state, device_batch)
                 if watchdog and first_call:
                     # the compile happened inside that call; from here
                     # the steady-state deadline applies
@@ -527,6 +651,7 @@ class Trainer:
                 step += 1
                 steps_since_log += 1
                 health_state["step"] = step
+                _progress()
 
                 if (res.FAULT_INJECT_NAN_STEP and not nan_injected
                         and step == res.FAULT_INJECT_NAN_STEP):
@@ -541,20 +666,32 @@ class Trainer:
                         lambda x: x * jnp.asarray(jnp.nan, x.dtype),
                         state.params))
 
-                if (profile_steps and profile_until is None
-                        and jax.process_index() == 0):
-                    # first step (compile) done — trace steady-state steps
+                # on-demand profiler capture: ONE executor for all
+                # three request paths — the --profile CLI flag, GET
+                # /debugz/profile, and the anomaly trigger.  Start and
+                # stop land at step boundaries with the loss
+                # materialized, so the trace covers whole steps.
+                if capture is None:
+                    req = None
+                    if profile_steps and jax.process_index() == 0:
+                        # CLI path keeps its historical semantics:
+                        # rank 0 only, starts after the first
+                        # (compile) step, no trigger guard rails
+                        req = {"steps": profile_steps, "reason": "cli",
+                               "from_trigger": False}
+                        profile_steps = 0
+                    elif profile_trigger is not None:
+                        req = profile_trigger.take()
+                        if req is not None:
+                            req["from_trigger"] = True
+                    if req is not None:
+                        jax.block_until_ready(metrics["total_loss"])
+                        capture = self._start_capture(req, step)
+                elif step >= capture["until"]:
                     jax.block_until_ready(metrics["total_loss"])
-                    jax.profiler.start_trace(
-                        os.path.join(self.logdir, "profile"))
-                    profile_until = step + profile_steps
-                elif profile_until is not None and step >= profile_until:
-                    jax.block_until_ready(metrics["total_loss"])
-                    jax.profiler.stop_trace()
-                    log.info("profiler trace written to %s/profile",
-                             self.logdir)
-                    profile_until = None
-                    profile_steps = 0
+                    capture = self._finish_capture(capture,
+                                                   profile_trigger,
+                                                   step)
 
                 log_step = (step % cfg.TRAIN.LOG_PERIOD == 0
                             or step == total_steps)
@@ -575,13 +712,18 @@ class Trainer:
                         state, step = self._rollback(sentinel, state,
                                                      step,
                                                      watchdog=watchdog)
+                        _progress()  # recovery, not a hang
                         steps_since_log = 0
                         t_last = time.time()
                         continue
 
                 if log_step:
-                    metrics = jax.tree.map(lambda x: float(np.asarray(x)),
-                                           metrics)
+                    # host_metrics: where the device sync actually
+                    # lands on log steps — a long one means the device
+                    # is still chewing on the interval's steps
+                    with telemetry.span("host_metrics", step=step):
+                        metrics = jax.tree.map(
+                            lambda x: float(np.asarray(x)), metrics)
                     if data_health is not None:
                         metrics.update(
                             {f"data/{k}": float(v) for k, v
@@ -605,6 +747,7 @@ class Trainer:
                     metrics["step_time_ms"] = round(step_time_ms, 2)
                     step_time_hist.observe(step_time_ms)
                     steps_since_log = 0
+                    agg = None
                     if aggregate_hosts:
                         # cross-host min/max/mean + straggler index:
                         # host-side allgather OUTSIDE jit, zero RNG —
@@ -614,9 +757,48 @@ class Trainer:
                         hv = {k: metrics.get(f"data/{k}", 0.0)
                               for k in telemetry.HOST_AGG_KEYS}
                         hv["step_time_ms"] = step_time_ms
-                        agg = telemetry.aggregate_host_scalars(hv)
+                        with telemetry.span("host_aggregate",
+                                            step=step):
+                            agg = telemetry.aggregate_host_scalars(hv)
                         telemetry.publish_aggregates(agg, registry)
                         metrics.update(agg)
+                    if detector is not None:
+                        # anomaly trigger: a persistent step-time p95
+                        # regression or straggler fires the SAME
+                        # guarded capture /debugz/profile uses, so the
+                        # incident's trace exists before anyone is
+                        # paged.  agg values are host-identical (they
+                        # came off a collective), so all hosts request
+                        # together and each captures its own trace.
+                        lag = spread = None
+                        if agg is not None:
+                            mean = agg.get("hosts/step_time_ms_mean",
+                                           0.0)
+                            if mean > 0:
+                                lag = agg.get("hosts/lagging")
+                                spread = (agg.get(
+                                    "hosts/step_time_ms_max", 0.0)
+                                    / mean)
+                        reason = detector.observe(
+                            step_time_ms, lagging_host=lag,
+                            spread_ratio=spread)
+                        if (reason is not None
+                                and profile_trigger is not None):
+                            ok, detail = profile_trigger.request(
+                                steps=int(
+                                    self._tracing["PROFILE_STEPS"]),
+                                reason=f"anomaly: {reason}")
+                            log.warning(
+                                "telemetry anomaly at step %d: %s — "
+                                "profile capture %s (%s)", step,
+                                reason,
+                                "accepted" if ok else "rejected",
+                                detail)
+                            telemetry.event(
+                                "anomaly_detected", step=step,
+                                reason=reason,
+                                capture=("accepted" if ok
+                                         else detail))
                     if self.writer:
                         self.writer.write_scalars(step, metrics)
                     log.info("step %d/%d loss=%.4f (%.1f img/s)", step,
@@ -661,11 +843,13 @@ class Trainer:
                         if self.writer:
                             self.writer.write_scalars(step, {
                                 "checkpoint_save_ms": save_ms})
+                        _progress()  # a slow shared-fs commit is not a hang
                 if self.eval_fn and (step % eval_every == 0
                                      or step == total_steps):
                     if watchdog:
                         watchdog.beat("eval", step)
                     self._run_eval(state, step)
+                    _progress()  # an eval pass is not a hang
 
                 # graceful preemption: every host polls at the same
                 # steps (the poll is a collective in multi-host) so a
@@ -681,13 +865,21 @@ class Trainer:
                 if watchdog:
                     watchdog.beat("next_batch", step)
         finally:
-            if profile_until is not None:
-                # run ended before profile_steps elapsed — close the
-                # trace so it still lands (and a later start_trace
+            if capture is not None:
+                # run ended before the capture's steps elapsed — close
+                # the trace so it still lands (and a later start_trace
                 # won't raise)
-                jax.profiler.stop_trace()
-                log.info("profiler trace (truncated run) written to "
-                         "%s/profile", self.logdir)
+                self._finish_capture(capture, profile_trigger, step,
+                                     truncated=True)
+            if self.tracer is not None:
+                # steady-state spans land even without a capture: the
+                # cross-host merge works from whatever the ring holds
+                self.tracer.flush()
+                # uninstall so later spans in this process (another
+                # Trainer, eval tooling) can't record into THIS run's
+                # ring and be flushed into its trace file
+                if telemetry.get_tracer() is self.tracer:
+                    telemetry.install_tracer(None)
             if watchdog:
                 watchdog.stop()
             if preempt is not None:
@@ -720,6 +912,63 @@ class Trainer:
                               "during shutdown failed (keeping the "
                               "original exception)")
         return state
+
+    def _start_capture(self, req: Dict, step: int) -> Dict:
+        """Begin a bounded profiler capture: ``jax.profiler`` trace
+        into ``<logdir>/profile`` plus a span-ring marker.  A profiler
+        that refuses to start degrades to span-only capture — the
+        capture must never take down training."""
+        started = False
+        try:
+            jax.profiler.start_trace(
+                os.path.join(self.logdir, "profile"))
+            started = True
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            log.warning("jax.profiler capture failed to start — "
+                        "continuing with span capture only",
+                        exc_info=True)
+        until = step + int(req["steps"])
+        if self.tracer is not None:
+            self.tracer.instant("profile_capture_start", step=step,
+                                reason=str(req.get("reason", "?")))
+        telemetry.event("profile_capture", step=step,
+                        reason=str(req.get("reason", "?")),
+                        steps=int(req["steps"]),
+                        profiler=started)
+        log.info("profile capture started at step %d (%s): %d "
+                 "step(s) into %s/profile", step,
+                 req.get("reason", "?"), int(req["steps"]),
+                 self.logdir)
+        return {"until": until, "profiler": started,
+                "reason": str(req.get("reason", "?")),
+                "from_trigger": bool(req.get("from_trigger", False))}
+
+    def _finish_capture(self, capture: Dict, trigger, step: int,
+                        truncated: bool = False) -> None:
+        """Close an in-flight capture: stop the profiler trace, flush
+        the span ring to ``trace-host<i>.json``, start the trigger's
+        cooldown.  Returns None (the new ``capture`` state)."""
+        if capture["profiler"]:
+            try:
+                jax.profiler.stop_trace()
+                log.info("profiler trace%s written to %s/profile",
+                         " (truncated run)" if truncated else "",
+                         self.logdir)
+            except Exception:  # noqa: BLE001 — shutdown must proceed
+                log.warning("jax.profiler stop_trace failed",
+                            exc_info=True)
+        span_path = None
+        if self.tracer is not None:
+            self.tracer.instant("profile_capture_done", step=step,
+                                reason=capture["reason"])
+            span_path = self.tracer.flush()
+        telemetry.event("profile_capture_done", step=step,
+                        reason=capture["reason"],
+                        truncated=bool(truncated),
+                        spans=span_path or "")
+        if capture["from_trigger"] and trigger is not None:
+            trigger.finish()
+        return None
 
     def _rollback(self, sentinel: DivergenceSentinel, state: TrainState,
                   step: int, watchdog=None) -> Tuple[TrainState, int]:
@@ -797,7 +1046,8 @@ class Trainer:
 
     def _run_eval(self, state, step):
         try:
-            results = self.eval_fn(self.model, state.params, step)
+            with telemetry.span("eval", step=step):
+                results = self.eval_fn(self.model, state.params, step)
             if results and self.writer:
                 self.writer.write_scalars(
                     step, {f"val/{k}": v for k, v in results.items()})

@@ -52,8 +52,11 @@ class CheckpointManager:
     # -- save ----------------------------------------------------------
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
-        saved = self._mngr.save(
-            step, args=ocp.args.StandardSave(state), force=force)
+        # span = the step-loop BLOCKING portion (async snapshot +
+        # dispatch); the background persist is invisible here by design
+        with telemetry.span("checkpoint_save", step=step):
+            saved = self._mngr.save(
+                step, args=ocp.args.StandardSave(state), force=force)
         if saved:
             # Orbax serialized save N before starting N+1, so every
             # previously pending step is committed by now — publish
@@ -102,8 +105,9 @@ class CheckpointManager:
         if step is None:
             return None
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
-        return self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+        with telemetry.span("checkpoint_restore", step=step):
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
 
     def restore_with_fallback(
             self, state_like: Any) -> Optional[Tuple[Any, int]]:

@@ -40,6 +40,15 @@ Data-ingest rungs (eksml_tpu/data/robust.py, ISSUE 2):
                       MAX_QUARANTINE_FRAC: the run aborts with an
                       actionable error naming the ledger path.
 
+Observability rung (eksml_tpu/telemetry/tracing.py, ISSUE 5):
+
+  debugz-profile      GET /debugz/profile?steps=N against a live
+                      trainer with span tracing enabled: the capture
+                      artifact lands as valid Chrome-trace JSON,
+                      trace_summary --merge renders the timeline
+                      naming dominant spans, and losses stay
+                      bit-identical with tracing on.
+
 Subprocess rungs are ``chaos`` + ``slow`` (each launches 1-2
 subprocess trainers; the module-shared compile cache keeps the total
 to ONE tiny XLA compile); the in-process data rungs are ``chaos``
@@ -429,6 +438,99 @@ def test_nan_loss_rolls_back_and_never_checkpoints_poison(
     assert "| rollback |" in report
     assert "non-finite scalar rows" in report
     assert "### Segment 1" in report
+
+
+# ---- rung 4b: on-demand profile capture (debugz + span tracing) ------
+
+
+@pytest.mark.slow
+def test_debugz_profile_capture_midrun_with_tracing(tmp_path,
+                                                    compile_cache):
+    """Chaos rung (ISSUE 5): a mid-run ``GET /debugz/profile?steps=2``
+    starts a bounded capture through the ProfileTrigger; the span
+    artifact lands as valid Chrome-trace JSON whose spans carry
+    step/host attribution, ``trace_summary --merge`` renders a
+    timeline naming the dominant span of the slowest step, and losses
+    are bit-identical to a tracing-disabled run of the same
+    schedule."""
+    import urllib.request
+
+    logdir = str(tmp_path / "run")
+    config = [c for c in TINY if "MAX_EPOCHS" not in c] + [
+        "TRAIN.MAX_EPOCHS=8",  # 16 steps: room for the mid-run capture
+        "TELEMETRY.PORT=0",
+        "TELEMETRY.TRACING.ENABLED=True",
+    ]
+    log1 = str(tmp_path / "run1.log")
+    proc = _launch(logdir, compile_cache, log1, config)
+    try:
+        _wait_for_first_step(proc, logdir, log1)
+        port_file = os.path.join(logdir, "telemetry-host0.port")
+        port = int(open(port_file).read())
+        resp = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debugz/profile?steps=2",
+            timeout=30).read())
+        accepted = resp["status"] == "accepted"
+        # the stacks endpoint answers against the live trainer too
+        stacks = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debugz/stacks",
+            timeout=30).read().decode()
+        assert "MainThread" in stacks
+        rc = proc.wait(timeout=900)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 0, open(log1).read()[-3000:]
+    if not accepted:
+        pytest.skip("run outran the debugz request on this machine — "
+                    "inconclusive")
+
+    # flight recorder: the capture chain landed in order
+    kinds = _event_kinds(logdir)
+    assert "profile_capture" in kinds, kinds
+    assert "profile_capture_done" in kinds[
+        kinds.index("profile_capture"):], kinds
+
+    # span artifact: valid Chrome-trace JSON, step/host attribution
+    trace_path = os.path.join(logdir, "trace-host0.json")
+    assert os.path.exists(trace_path), os.listdir(logdir)
+    doc = json.load(open(trace_path))
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert spans, "capture produced no spans"
+    assert all(e["args"]["host"] == 0 for e in spans)
+    step_spans = [e for e in spans if e["name"] == "train_step"]
+    assert step_spans and all(
+        isinstance(e["args"]["step"], int) for e in step_spans)
+
+    # acceptance: the merge renders ONE timeline and names the
+    # dominant span of the slowest step
+    from tools import run_report, trace_summary
+
+    merged = trace_summary.merge_host_traces(logdir)
+    assert merged["hosts"] == [0]
+    assert merged["steps_covered"] >= 2
+    assert merged["slow_steps"][0].get("dominant_span"), merged
+    report = run_report.render_report(logdir)
+    assert "## Slow steps (span tracing)" in report
+    assert merged["slow_steps"][0]["dominant_span"] in report
+
+    # bit-identity: the same 16-step schedule with tracing DISABLED
+    # (the default) must produce the exact same loss stream
+    logdir2 = str(tmp_path / "run2")
+    log2 = str(tmp_path / "run2.log")
+    config2 = [c for c in config
+               if not c.startswith("TELEMETRY.TRACING")]
+    proc2 = _launch(logdir2, compile_cache, log2, config2)
+    try:
+        assert proc2.wait(timeout=900) == 0, open(log2).read()[-2000:]
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+    losses1 = {r["step"]: r["total_loss"] for r in _metric_rows(logdir)
+               if "total_loss" in r}
+    losses2 = {r["step"]: r["total_loss"]
+               for r in _metric_rows(logdir2) if "total_loss" in r}
+    assert losses1 == losses2, "tracing perturbed the loss stream"
 
 
 # ---- rungs 5-7: data-ingest faults (loader level, in-process) --------

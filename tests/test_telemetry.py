@@ -238,6 +238,102 @@ def test_tier1_scrape_includes_aggregates_and_resilience_counters():
     assert "eksml_data_quarantined_records" in fams
 
 
+# ---- /healthz liveness + /debugz (ISSUE 5) --------------------------
+
+
+def test_healthz_liveness_503_past_staleness_bound():
+    """With a staleness bound, /healthz is a REAL k8s liveness probe:
+    200 while steps progress, 503 "stale" once seconds_since_last_step
+    exceeds the bound (the legacy always-200 made the probe useless)."""
+    state = {"since": 1.0}
+    ex = telemetry.TelemetryExporter(
+        port=0, registry=MetricRegistry(),
+        health_fn=lambda: {"step": 3,
+                           "seconds_since_last_step": state["since"]},
+        stale_after_sec=30.0).start()
+    try:
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/healthz", timeout=10).read())
+        assert hz["status"] == "ok"
+        assert hz["seconds_since_last_step"] == 1.0
+        state["since"] = 31.0
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ex.port}/healthz", timeout=10)
+        assert exc.value.code == 503
+        stale = json.loads(exc.value.read())
+        assert stale["status"] == "stale"
+        assert stale["stale_after_sec"] == 30.0
+    finally:
+        ex.stop()
+
+
+def test_healthz_stale_bound_zero_keeps_legacy_200():
+    ex = telemetry.TelemetryExporter(
+        port=0, registry=MetricRegistry(),
+        health_fn=lambda: {"seconds_since_last_step": 1e9}).start()
+    try:
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/healthz", timeout=10).read())
+        assert hz["status"] == "ok"
+    finally:
+        ex.stop()
+
+
+def test_debugz_profile_endpoint_drives_the_trigger():
+    trig = telemetry.ProfileTrigger(cooldown_sec=300.0,
+                                    max_captures=3, default_steps=3)
+    ex = telemetry.TelemetryExporter(
+        port=0, registry=MetricRegistry(),
+        profile_trigger=trig).start()
+    try:
+        url = f"http://127.0.0.1:{ex.port}/debugz/profile"
+        resp = json.loads(urllib.request.urlopen(
+            url + "?steps=5", timeout=10).read())
+        assert resp["status"] == "accepted" and resp["pending"]
+        # second request while one is pending: 429 + reason
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=10)
+        assert exc.value.code == 429
+        rej = json.loads(exc.value.read())
+        assert rej["status"] == "rejected"
+        assert "pending" in rej["detail"]
+        # the fit loop's side of the contract
+        req = trig.take()
+        assert req["steps"] == 5 and req["reason"] == "debugz"
+    finally:
+        ex.stop()
+
+
+def test_debugz_profile_without_trigger_is_503():
+    ex = telemetry.TelemetryExporter(
+        port=0, registry=MetricRegistry()).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ex.port}/debugz/profile",
+                timeout=10)
+        assert exc.value.code == 503
+        assert "no profile trigger" in json.loads(
+            exc.value.read())["detail"]
+    finally:
+        ex.stop()
+
+
+def test_debugz_stacks_dumps_threads():
+    ex = telemetry.TelemetryExporter(
+        port=0, registry=MetricRegistry()).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/debugz/stacks",
+            timeout=10).read().decode()
+        assert "MainThread" in body
+        # the serving thread itself shows up too
+        assert "eksml-telemetry-http" in body or "Thread-" in body
+    finally:
+        ex.stop()
+
+
 # ---- cross-host aggregation -----------------------------------------
 
 

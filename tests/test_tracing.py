@@ -1,0 +1,336 @@
+"""Unit half of the span-tracing layer (ISSUE 5).
+
+Tracer ring mechanics, the disabled-mode no-op contract, thread
+safety, step/host attribution, the ProfileTrigger guard rails, the
+anomaly detector, and the cross-host merge in
+tools/trace_summary.py.  The subprocess half (mid-run
+/debugz/profile capture against a real trainer) lives in
+tests/test_fault_tolerance.py.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from eksml_tpu import telemetry
+from eksml_tpu.telemetry.tracing import (NULL_SPAN, AnomalyDetector,
+                                         ProfileTrigger, Tracer,
+                                         format_thread_stacks)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """Every test starts and ends without an installed tracer."""
+    telemetry.install_tracer(None)
+    yield
+    telemetry.install_tracer(None)
+
+
+# ---- ring + span mechanics ------------------------------------------
+
+
+def test_ring_is_bounded():
+    tr = Tracer(capacity=32)
+    for i in range(100):
+        with tr.span("s", step=i):
+            pass
+    events = tr.snapshot()
+    assert len(events) == 32  # ring bounded, oldest dropped
+    assert tr.spans_recorded == 100
+    assert events[-1]["args"]["step"] == 99
+    assert events[0]["args"]["step"] == 68
+
+
+def test_span_step_host_attribution_and_chrome_fields():
+    tr = Tracer(capacity=64, host_id=3)
+    with tr.span("train_step", step=7, attrs={"k": "v"}):
+        time.sleep(0.002)
+    (ev,) = tr.snapshot()
+    assert ev["name"] == "train_step" and ev["ph"] == "X"
+    assert ev["pid"] == 3 and ev["args"]["host"] == 3
+    assert ev["args"]["step"] == 7 and ev["args"]["k"] == "v"
+    assert ev["dur"] >= 2000  # µs
+    assert isinstance(ev["ts"], float) and isinstance(ev["tid"], int)
+
+
+def test_disabled_mode_is_a_shared_noop():
+    """No tracer installed → the module API returns ONE shared null
+    span (no per-call allocation); a disabled tracer behaves the
+    same."""
+    assert telemetry.get_tracer() is None
+    s1, s2 = telemetry.span("a", step=1), telemetry.span("b")
+    assert s1 is s2 is NULL_SPAN
+    with s1:
+        pass  # usable as a context manager
+    telemetry.complete_span("c", 0.0, 1.0)  # no-op, no raise
+    disabled = Tracer(capacity=16, enabled=False)
+    assert disabled.span("x") is NULL_SPAN
+    telemetry.install_tracer(disabled)
+    assert telemetry.span("y") is NULL_SPAN
+    assert disabled.snapshot() == []
+
+
+def test_module_install_and_complete_span():
+    tr = Tracer(capacity=16, host_id=1)
+    prev = telemetry.install_tracer(tr)
+    assert prev is None
+    with telemetry.span("data_wait", step=4):
+        pass
+    t0 = time.perf_counter()
+    telemetry.complete_span("batch_build", t0,
+                            time.perf_counter() + 0.001, seq=2)
+    names = [e["name"] for e in tr.snapshot()]
+    assert names == ["data_wait", "batch_build"]
+    assert tr.snapshot()[1]["args"]["seq"] == 2
+
+
+def test_traced_decorator():
+    tr = Tracer(capacity=16)
+    telemetry.install_tracer(tr)
+
+    @telemetry.traced("hot_fn")
+    def fn(x):
+        return x + 1
+
+    assert fn(1) == 2
+    assert tr.snapshot()[0]["name"] == "hot_fn"
+
+
+def test_thread_safety_and_flush_is_valid_chrome_trace(tmp_path):
+    path = telemetry.trace_path_for(str(tmp_path), 2)
+    assert path.endswith("trace-host2.json")
+    tr = Tracer(capacity=512, path=path, host_id=2)
+
+    def worker(n):
+        for i in range(200):
+            with tr.span(f"w{n}", step=i):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(n,))
+               for n in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tr.spans_recorded == 1600
+    out = tr.flush()
+    assert out == path
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    # process metadata + a full ring, every event host-stamped
+    assert events[0]["ph"] == "M"
+    spans = [e for e in events if e["ph"] == "X"]
+    assert len(spans) == 512
+    assert all(e["pid"] == 2 and e["args"]["host"] == 2
+               for e in spans)
+
+
+def test_flush_without_path_is_noop_and_close_flushes(tmp_path):
+    assert Tracer(capacity=16).flush() is None  # no path, no raise
+    path = str(tmp_path / "trace-host0.json")
+    tr = Tracer(capacity=16, path=path)
+    with tr.span("a"):
+        pass
+    tr.instant("profile_capture_start", step=1, reason="test")
+    tr.close()
+    doc = json.load(open(path))
+    kinds = {e["name"] for e in doc["traceEvents"]}
+    assert {"a", "profile_capture_start"} <= kinds
+
+
+# ---- ProfileTrigger guard rails -------------------------------------
+
+
+def test_profile_trigger_lifecycle_and_cooldown():
+    clock = {"t": 100.0}
+    trig = ProfileTrigger(cooldown_sec=60.0, max_captures=2,
+                          default_steps=3,
+                          clock=lambda: clock["t"])
+    ok, detail = trig.request(steps=5, reason="debugz")
+    assert ok and "5 step(s)" in detail
+    # pending blocks a second request regardless of cooldown
+    ok2, detail2 = trig.request()
+    assert not ok2 and "pending" in detail2
+    req = trig.take()
+    assert req["steps"] == 5 and req["reason"] == "debugz"
+    assert trig.take() is None  # consumed
+    # active capture blocks requests
+    ok3, detail3 = trig.request()
+    assert not ok3 and "in progress" in detail3
+    trig.finish()
+    # cooldown: rejected until the clock advances past it
+    ok4, detail4 = trig.request()
+    assert not ok4 and "cooldown" in detail4
+    clock["t"] += 61.0
+    ok5, _ = trig.request()
+    assert ok5
+    trig.take()
+    trig.finish()
+    clock["t"] += 61.0
+    # max captures per run
+    ok6, detail6 = trig.request()
+    assert not ok6 and "max captures" in detail6
+    st = trig.status()
+    assert st["captures_started"] == 2 and st["rejected"] == 4
+
+
+def test_profile_trigger_rejects_bad_steps():
+    trig = ProfileTrigger(default_steps=3, max_steps=10)
+    assert not trig.request(steps="bogus")[0]
+    assert not trig.request(steps=-1)[0]
+    ok, detail = trig.request(steps=999)  # clamped, not rejected
+    assert ok and "10 step(s)" in detail
+    ok2, _ = trig.request(steps=None)
+    assert not ok2  # already pending
+
+
+# ---- anomaly detector ------------------------------------------------
+
+
+def test_anomaly_detector_p95_regression_needs_k_consecutive():
+    det = AnomalyDetector(k_intervals=3, p95_factor=1.5,
+                          min_history=8)
+    for _ in range(10):
+        assert det.observe(100.0) is None
+    # two anomalous intervals + a recovery: no fire, streak resets
+    assert det.observe(300.0) is None
+    assert det.observe(300.0) is None
+    assert det.observe(100.0) is None
+    # three consecutive: fires once, then the streak resets
+    assert det.observe(300.0) is None
+    assert det.observe(310.0) is None
+    reason = det.observe(320.0)
+    assert reason is not None and "p95_regression" in reason
+    assert det.observe(300.0) is None  # streak restarted
+    assert det.fired == 1
+
+
+def test_anomaly_detector_baseline_excludes_slow_streak():
+    """A building regression must not drag the rolling p95 up under
+    itself — only healthy intervals feed the baseline."""
+    det = AnomalyDetector(k_intervals=30, p95_factor=1.5,
+                          min_history=8, window=8)
+    for _ in range(8):
+        det.observe(100.0)
+    for _ in range(20):
+        det.observe(400.0)  # long streak, below k
+    assert sorted(det._history)[-1] == 100.0
+
+
+def test_anomaly_detector_persistent_straggler():
+    det = AnomalyDetector(k_intervals=3, spread_factor=1.5,
+                          min_history=8)
+    # same host lagging but tiny spread: argmax noise, never fires
+    for _ in range(10):
+        assert det.observe(100.0, lagging_host=2,
+                           spread_ratio=1.1) is None
+    # real spread, same host, K consecutive
+    assert det.observe(100.0, lagging_host=2,
+                       spread_ratio=2.0) is None
+    assert det.observe(100.0, lagging_host=2,
+                       spread_ratio=2.0) is None
+    reason = det.observe(100.0, lagging_host=2, spread_ratio=2.0)
+    assert reason is not None and "host 2" in reason
+    # a different host resets the streak
+    assert det.observe(100.0, lagging_host=0,
+                       spread_ratio=2.0) is None
+    assert det.observe(100.0, lagging_host=1,
+                       spread_ratio=2.0) is None
+
+
+# ---- /debugz/stacks payload -----------------------------------------
+
+
+def test_format_thread_stacks_lists_live_threads():
+    text = format_thread_stacks()
+    assert "MainThread" in text
+    assert "test_format_thread_stacks_lists_live_threads" in text
+
+
+# ---- cross-host merge (tools/trace_summary.py --merge) ---------------
+
+
+def _host_events(host, skew_us, slow_step=None):
+    """Five steps of the fit loop's span shape.  The slow step stalls
+    in data_wait while its train_step DISPATCH stays short — the
+    async-accelerator signature the ranking must still catch."""
+    evs = []
+    for step in range(1, 6):
+        base = skew_us + 1_000_000 + 10_000 * step
+        evs.append({"name": "train_step", "ph": "X", "ts": base,
+                    "dur": 800.0, "pid": host, "tid": 1,
+                    "args": {"host": host, "step": step}})
+        evs.append({"name": "data_wait", "ph": "X", "ts": base - 500,
+                    "dur": 8_000 if step == slow_step else 90.0,
+                    "pid": host, "tid": 1,
+                    "args": {"host": host, "step": step}})
+    return evs
+
+
+def _write_host_trace(logdir, host, events):
+    with open(os.path.join(logdir, f"trace-host{host}.json"),
+              "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_merge_aligns_clocks_and_names_dominant_span(tmp_path):
+    from tools import trace_summary
+
+    logdir = str(tmp_path)
+    _write_host_trace(logdir, 0, _host_events(0, 0))
+    # host 1's wall clock is 7 s ahead (NTP skew) and step 3 stalls
+    # in data_wait
+    _write_host_trace(logdir, 1,
+                      _host_events(1, 7_000_000, slow_step=3))
+    merged = trace_summary.merge_host_traces(logdir)
+    assert merged["hosts"] == [0, 1]
+    # the skew was recovered from step boundaries
+    assert abs(merged["host_offsets_us"]["1"] + 7_000_000) < 1_000
+    assert merged["steps_covered"] == 5
+    slow = merged["slow_steps"][0]
+    assert slow["step"] == 3 and slow["host"] == 1
+    # per-step wall = Σ of the loop's spans (8.0 wait + 0.8 dispatch):
+    # ranking by the dispatch span alone would hide the starved step
+    assert slow["ms"] == 8.8
+    assert slow["dominant_span"] == "data_wait"
+    assert slow["dominant_ms"] == 8.0
+    # merged timeline: host 1's aligned events interleave host 0's
+    aligned = [e for e in merged["traceEvents"]
+               if e.get("pid") == 1 and e.get("name") == "train_step"]
+    ref = [e for e in merged["traceEvents"]
+           if e.get("pid") == 0 and e.get("name") == "train_step"]
+    assert abs(aligned[0]["ts"] - ref[0]["ts"]) < 1_000
+
+
+def test_merge_missing_traces_raises(tmp_path):
+    from tools import trace_summary
+
+    with pytest.raises(FileNotFoundError):
+        trace_summary.merge_host_traces(str(tmp_path))
+
+
+def test_merge_cli_and_run_report_section(tmp_path):
+    from tools import run_report, trace_summary
+
+    logdir = str(tmp_path)
+    _write_host_trace(logdir, 0, _host_events(0, 0, slow_step=2))
+    out = str(tmp_path / "merged.json")
+    assert trace_summary.main([logdir, "--merge", "--out", out]) == 0
+    doc = json.load(open(out))
+    assert doc["slow_steps"][0]["step"] == 2
+    assert any(e["name"] == "train_step" for e in doc["traceEvents"])
+    # run_report names the dominant span in its slow-steps table
+    report = run_report.render_report(logdir)
+    assert "## Slow steps (span tracing)" in report
+    assert "| 2 | 0 | 8.8 |" in report
+    assert "data_wait" in report
+
+
+def test_run_report_degrades_without_traces(tmp_path):
+    from tools import run_report
+
+    report = run_report.render_report(str(tmp_path))
+    assert "No trace-host*.json found" in report

@@ -1,0 +1,209 @@
+"""Gate a fresh bench.py JSON line against the banked trajectory.
+
+The repo banks one ``BENCH_r<NN>.json`` per round (the driver wraps
+``bench.py`` stdout as ``{"n", "cmd", "rc", "tail"}``), but nothing
+ever COMPARED a new measurement against that trajectory — a step-time
+regression only surfaced when a human eyeballed the numbers.  This
+tool is the missing regression gate:
+
+- the **bank** is every ``BENCH_r*.json`` (newest = highest round);
+  each file's ``tail`` is scanned for its last ``{"metric": ...}``
+  line.  Error lines (tunnel down, ``value == 0``) fall back to the
+  line's ``last_good`` snapshot — the trajectory stays usable across
+  rounds whose hardware was unreachable.
+- the **fresh** measurement is a bench JSON line (or raw bench.py
+  stdout) from a file or stdin.
+- the gate FAILS (exit 1) when fresh ``step_time_ms`` exceeds the
+  newest usable banked step time by more than ``--max-regress-pct``
+  (or when throughput ``value`` drops by more than the same bound,
+  when both carry it).  A fresh error line fails too — a gate that
+  passes on "the bench crashed" is not a gate.
+
+Usage::
+
+    python bench.py ... | python tools/bench_gate.py --fresh - \
+        --max-regress-pct 10
+    python tools/bench_gate.py --fresh bench_out.json \
+        --bank 'BENCH_r*.json' --allow-missing-baseline
+
+The CPU-smoke half lives in tests/test_bench_gate.py (tier-1): it
+drives this gate over synthetic banked files, so the comparison logic
+is exercised on every CI run without touching hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# a usable measurement needs a positive throughput and a step time —
+# the two numbers the gate compares
+METRIC_LINE_RE = re.compile(r'^\s*\{"metric"')
+
+
+def extract_metric_line(text: str) -> Optional[Dict]:
+    """Last ``{"metric": ...}`` JSON object in ``text`` (bench.py
+    prints exactly one as its final line; banked files wrap whole
+    stdout)."""
+    last = None
+    for line in text.splitlines():
+        if METRIC_LINE_RE.match(line):
+            try:
+                last = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return last
+
+
+def usable_measurement(line: Optional[Dict]) -> Optional[Dict]:
+    """The comparable core of a bench line: the line itself when it
+    carries a real measurement, else its ``last_good`` snapshot (the
+    stale-but-honest fallback bench.py emits when hardware was
+    unreachable), else None."""
+    if not isinstance(line, dict):
+        return None
+
+    def _ok(d: Dict) -> bool:
+        # both compared numbers must be real: a step_time_ms of 0
+        # would divide the gate by zero as a baseline and trivially
+        # PASS as a fresh line — "the bench crashed" must fail
+        return ((d.get("value", 0) or 0) > 0
+                and (d.get("step_time_ms", 0) or 0) > 0)
+
+    if _ok(line):
+        return line
+    lg = line.get("last_good")
+    if isinstance(lg, dict) and _ok(lg):
+        return lg
+    return None
+
+
+def _round_key(path: str) -> Tuple:
+    """Sort key = the integer round parsed from the filename, so
+    BENCH_r100 orders AFTER BENCH_r99 (lexicographic glob order would
+    pin the baseline at r99 forever once rounds outgrow the zero
+    padding); non-matching names fall back to plain name order."""
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return (0, int(m.group(1)), path) if m else (1, 0, path)
+
+
+def load_bank(pattern: str) -> List[Tuple[str, Dict]]:
+    """[(path, usable measurement)] for every banked round that has
+    one, in round order (numeric — BENCH_r99 < BENCH_r100)."""
+    out = []
+    for path in sorted(glob.glob(pattern), key=_round_key):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        text = payload.get("tail", "") if isinstance(payload, dict) \
+            else ""
+        m = usable_measurement(extract_metric_line(text))
+        if m is not None:
+            out.append((path, m))
+    return out
+
+
+def gate(fresh: Optional[Dict], bank: List[Tuple[str, Dict]],
+         max_regress_pct: float,
+         allow_missing_baseline: bool = False) -> Tuple[bool, Dict]:
+    """(ok, verdict).  The baseline is the NEWEST usable banked round
+    — the gate answers "did this change regress the trajectory", not
+    "is this the best number ever banked" (the best-ever number is
+    reported for context)."""
+    verdict: Dict = {"max_regress_pct": max_regress_pct}
+    fresh_m = usable_measurement(fresh)
+    if fresh_m is None or fresh_m is not fresh:
+        # an error line (or one only usable via last_good) is not a
+        # fresh measurement of THIS change
+        verdict["error"] = ("fresh bench line carries no usable "
+                            "measurement (value<=0, missing "
+                            "step_time_ms, or error payload)")
+        verdict["fresh"] = fresh
+        return False, verdict
+    verdict["fresh"] = {k: fresh_m.get(k)
+                        for k in ("value", "step_time_ms", "unit")}
+    if not bank:
+        verdict["baseline"] = None
+        verdict["note"] = "no usable banked baseline"
+        return allow_missing_baseline, verdict
+    base_path, base = bank[-1]
+    best = min(bank, key=lambda pm: pm[1]["step_time_ms"])
+    verdict["baseline"] = {"path": base_path,
+                           "value": base.get("value"),
+                           "step_time_ms": base["step_time_ms"]}
+    verdict["best_banked"] = {"path": best[0],
+                              "step_time_ms": best[1]["step_time_ms"]}
+    limit = float(base["step_time_ms"]) * (1 + max_regress_pct / 100.0)
+    step_regress_pct = (float(fresh_m["step_time_ms"])
+                        / float(base["step_time_ms"]) - 1) * 100.0
+    verdict["step_time_regress_pct"] = round(step_regress_pct, 2)
+    ok = float(fresh_m["step_time_ms"]) <= limit
+    if not ok:
+        verdict["error"] = (
+            f"step_time_ms regressed {step_regress_pct:.1f}% vs "
+            f"{base_path} ({fresh_m['step_time_ms']} > "
+            f"{base['step_time_ms']} +{max_regress_pct}%)")
+        return False, verdict
+    # throughput cross-check when both sides carry it (value is
+    # images/sec/chip — a DROP is the regression direction)
+    if (base.get("value") or 0) > 0 and (fresh_m.get("value") or 0) > 0:
+        tp_drop_pct = (1 - float(fresh_m["value"])
+                       / float(base["value"])) * 100.0
+        verdict["throughput_drop_pct"] = round(tp_drop_pct, 2)
+        if tp_drop_pct > max_regress_pct:
+            verdict["error"] = (
+                f"throughput dropped {tp_drop_pct:.1f}% vs "
+                f"{base_path} ({fresh_m['value']} < {base['value']} "
+                f"-{max_regress_pct}%)")
+            return False, verdict
+    return True, verdict
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--fresh", required=True,
+                   help="fresh bench JSON line / bench.py stdout "
+                        "(file path, or '-' for stdin)")
+    p.add_argument("--bank", default=None,
+                   help="glob of banked rounds (default: "
+                        "BENCH_r*.json next to this repo's root)")
+    p.add_argument("--max-regress-pct", type=float, default=10.0,
+                   help="max tolerated step-time increase (and "
+                        "throughput drop) in percent [%(default)s]")
+    p.add_argument("--allow-missing-baseline", action="store_true",
+                   help="exit 0 when no banked round carries a "
+                        "usable measurement (first round on new "
+                        "hardware)")
+    args = p.parse_args(argv)
+
+    if args.fresh == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.fresh) as f:
+            text = f.read()
+    fresh = extract_metric_line(text)
+
+    pattern = args.bank
+    if pattern is None:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        pattern = os.path.join(repo, "BENCH_r*.json")
+    bank = load_bank(pattern)
+
+    ok, verdict = gate(fresh, bank, args.max_regress_pct,
+                       allow_missing_baseline=args
+                       .allow_missing_baseline)
+    verdict["gate"] = "PASS" if ok else "FAIL"
+    print(json.dumps(verdict, indent=1))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

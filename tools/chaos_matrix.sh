@@ -10,6 +10,10 @@
 # assert the telemetry layer (eksml_tpu/telemetry/): the flight
 # recorder captured the incident chain in order, /metrics scraped as
 # valid OpenMetrics mid-run, and run_report.py renders the post-mortem.
+# proc-debugz-profile drives the span-tracing layer (ISSUE 5): a
+# mid-run /debugz/profile capture lands Chrome-trace span artifacts,
+# trace_summary --merge names dominant spans, losses stay
+# bit-identical with tracing on.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # processes and are marked slow (excluded from tier-1); the unit and
 # data-* rungs run in seconds.  Everything runs under
@@ -34,6 +38,7 @@ RUNGS=(
   "unit-init-retry|tests/test_resilience.py tests/test_distributed.py -k 'retry or retries or exhaustion'"
   "unit-data-robust|tests/test_data_robust.py"
   "unit-telemetry|tests/test_telemetry.py tests/test_run_report.py"
+  "unit-tracing|tests/test_tracing.py tests/test_bench_gate.py"
   "data-corrupt-jpeg|'tests/test_fault_tolerance.py::test_data_fault_rung[corrupt-jpeg]'"
   "data-missing-file|'tests/test_fault_tolerance.py::test_data_fault_rung[missing-file]'"
   "data-eio-recover|'tests/test_fault_tolerance.py::test_data_fault_rung[eio-recover]'"
@@ -42,6 +47,7 @@ RUNGS=(
   "proc-sigterm-graceful|tests/test_fault_tolerance.py::test_sigterm_graceful_preempt_then_resume"
   "proc-corrupt-latest|tests/test_fault_tolerance.py::test_corrupt_latest_checkpoint_falls_back"
   "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"
+  "proc-debugz-profile|tests/test_fault_tolerance.py::test_debugz_profile_capture_midrun_with_tracing"
   "proc-data-chaos|tests/test_fault_tolerance.py::test_data_chaos_train_completes_with_quarantine"
   "proc-data-breaker|tests/test_fault_tolerance.py::test_quarantine_overflow_aborts_actionably"
 )

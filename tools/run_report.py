@@ -19,6 +19,10 @@ tool folds them into one reviewable report:
 - **Non-finite observations**: rows whose scalars were sanitized to
   ``null`` (the ``*_raw_repr`` satellite), i.e. exactly where the loss
   went bad.
+- **Slow steps**: when the run banked span traces
+  (``trace-host<i>.json``, TELEMETRY.TRACING), the cross-host merge
+  names the dominant span of each outlier step — "step 412: host 3,
+  1.9 s in data_wait" — via ``tools/trace_summary.py``'s merge.
 - **Modeled cost**: the attribution component table, when the run
   banked a profile.
 
@@ -203,6 +207,47 @@ def _events_section(events: List[Dict], max_events: int) -> List[str]:
     return lines
 
 
+def _slow_steps_section(logdir: str) -> List[str]:
+    """Outlier steps named by their dominant span, from the merged
+    per-host span traces (telemetry tracing, ISSUE 5)."""
+    lines = ["## Slow steps (span tracing)"]
+    try:
+        try:
+            from tools import trace_summary
+        except ImportError:  # script mode: tools/ is sys.path[0]
+            import trace_summary
+        merged = trace_summary.merge_host_traces(logdir)
+    except FileNotFoundError:
+        lines += ["", "No trace-host*.json found — enable "
+                      "`TELEMETRY.TRACING.ENABLED` (or trigger a "
+                      "`/debugz/profile` capture) to record span "
+                      "timelines."]
+        return lines
+    except Exception as e:  # noqa: BLE001 — partial evidence is fine
+        lines += ["", f"Could not merge span traces: {e!r}"]
+        return lines
+    if not merged["slow_steps"]:
+        lines += ["", "Span traces present but no completed "
+                      f"`{trace_summary.STEP_SPAN}` spans — capture "
+                      "covered no full step."]
+        return lines
+    lines += ["",
+              f"{merged['steps_covered']} step(s) traced across "
+              f"{len(merged['hosts'])} host(s); mean step "
+              f"{merged['mean_step_ms']} ms. Slowest:",
+              "",
+              "| step | slowest host | step ms | ×mean | "
+              "dominant span | span ms |",
+              "|---|---|---|---|---|---|"]
+    for s in merged["slow_steps"]:
+        lines.append(
+            f"| {s['step']} | {s['host']} | {s['ms']} "
+            f"| {s.get('vs_mean', '-')} "
+            f"| {s.get('dominant_span', '-')} "
+            f"| {s.get('dominant_ms', '-')} |")
+    return lines
+
+
 def _attribution_section(logdir: str,
                          attribution: Optional[str]) -> List[str]:
     path = attribution or os.path.join(logdir, "profile",
@@ -240,6 +285,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
         lines.extend(_segment_section(i, seg))
     lines.append("")
     lines.extend(_events_section(events, max_events))
+    lines.append("")
+    lines.extend(_slow_steps_section(logdir))
     lines.append("")
     lines.extend(_attribution_section(logdir, attribution))
     lines.append("")

@@ -17,10 +17,22 @@ first resolved through the compiled module's instruction→component map
 roi-bwd / fpn-conv-bwd / optimizer / allreduce … — alongside the
 legacy name-regex families.
 
+Cross-host span merge (ISSUE 5): with ``--merge`` the positional
+argument is a training LOGDIR holding the per-host span traces the
+telemetry tracer flushes (``trace-host<i>.json``,
+eksml_tpu/telemetry/tracing.py).  Host clocks are re-aligned on step
+boundaries (the median per-step offset of each host's ``train_step``
+span against host 0 — NTP skew cannot corrupt the timeline), the
+events merge into ONE Chrome-trace document (``pid`` = host), and the
+summary names the slowest steps with the dominant span on the
+slowest host — "step 412 was slow because host 3 sat 1.9 s in
+data_wait" instead of a bare ``hosts/lagging`` index.
+
 Usage::
 
     python tools/trace_summary.py profile --out artifacts/profile_summary_r3.json
     python tools/trace_summary.py profile --attribution profile/attribution.json
+    python tools/trace_summary.py <logdir> --merge --out merged_trace.json
 """
 
 from __future__ import annotations
@@ -171,6 +183,146 @@ def summarize(trace_dir: str, top_n: int = 15,
     return out
 
 
+# ---------------------------------------------------------------------
+# cross-host span-trace merge (trace-host<i>.json from the telemetry
+# tracer) — ISSUE 5
+# ---------------------------------------------------------------------
+
+STEP_SPAN = "train_step"  # the per-step anchor span the fit loop emits
+
+
+def load_host_traces(logdir: str) -> dict:
+    """{host_id: [events]} from every ``trace-host<i>.json`` under
+    ``logdir``."""
+    out: dict = {}
+    for path in sorted(glob.glob(
+            os.path.join(logdir, "trace-host*.json"))):
+        m = re.search(r"trace-host(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                events = json.load(f).get("traceEvents", [])
+        except (json.JSONDecodeError, OSError):
+            continue  # torn write from a killed process
+        out[int(m.group(1))] = events
+    if not out:
+        raise FileNotFoundError(
+            f"no trace-host<i>.json under {logdir!r} — run with "
+            "TELEMETRY.TRACING.ENABLED=True (or trigger a "
+            "/debugz/profile capture) first")
+    return out
+
+
+def _step_anchors(events) -> dict:
+    """{step: earliest ts} of the per-step anchor spans."""
+    anchors: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") != STEP_SPAN:
+            continue
+        step = (ev.get("args") or {}).get("step")
+        if step is None:
+            continue
+        ts = float(ev["ts"])
+        if step not in anchors or ts < anchors[step]:
+            anchors[step] = ts
+    return anchors
+
+
+def merge_host_traces(logdir: str, slow_top: int = 5) -> dict:
+    """Merge per-host span traces into one step-aligned timeline.
+
+    Alignment: per host, the median over common steps of (host0's
+    anchor ts − this host's anchor ts) becomes the host's clock
+    offset.  Step boundaries are collective in SPMD training, so the
+    median offset IS the clock skew; wall-clock (NTP) disagreement
+    drops out entirely.
+    """
+    traces = load_host_traces(logdir)
+    ref_host = min(traces)
+    ref_anchor = _step_anchors(traces[ref_host])
+
+    merged = []
+    offsets = {}
+    covered: dict = {}    # step -> {host} (hosts with the anchor span)
+    step_durs: dict = {}  # step -> {host: Σ step-attributed span µs}
+    span_max: dict = {}   # (step, host) -> (name, dur µs) longest one
+    for host, events in sorted(traces.items()):
+        anchors = _step_anchors(events)
+        common = sorted(set(anchors) & set(ref_anchor))
+        if host == ref_host or not common:
+            offset = 0.0
+        else:
+            deltas = sorted(ref_anchor[s] - anchors[s] for s in common)
+            offset = deltas[len(deltas) // 2]
+        offsets[host] = offset
+        for ev in events:
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + offset, 3)
+            ev["pid"] = host
+            merged.append(ev)
+            if ev.get("ph") != "X":
+                continue
+            step = (ev.get("args") or {}).get("step")
+            if step is None:
+                continue
+            step = int(step)
+            dur = float(ev.get("dur", 0.0))
+            if ev.get("name") == STEP_SPAN:
+                covered.setdefault(step, set()).add(host)
+            # per-step host wall = the SUM of the loop's sequential
+            # step-attributed spans, not the train_step dispatch
+            # alone: on an accelerator the dispatch returns
+            # immediately and the blocking lands in data_wait /
+            # host_metrics — ranking by dispatch would structurally
+            # hide input starvation, the main thing to catch
+            cur = step_durs.setdefault(step, {})
+            cur[host] = cur.get(host, 0.0) + dur
+            best = span_max.get((step, host))
+            if best is None or dur > best[1]:
+                span_max[(step, host)] = (ev["name"], dur)
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+
+    # per-step wall time = the slowest host's total (the synchronous-
+    # SPMD bound); only anchor-covered steps count (a lone
+    # host_metrics span from a partial capture is not a step)
+    steps = []
+    for step in sorted(covered):
+        by_host = {h: d for h, d in step_durs[step].items()
+                   if h in covered[step]}
+        if not by_host:
+            continue
+        slow_host = max(by_host, key=by_host.get)
+        steps.append({"step": step,
+                      "ms": round(by_host[slow_host] / 1000.0, 3),
+                      "host": slow_host,
+                      "hosts": len(by_host)})
+    slow_steps = []
+    if steps:
+        mean_ms = sum(s["ms"] for s in steps) / len(steps)
+        for s in sorted(steps, key=lambda s: -s["ms"])[:slow_top]:
+            entry = dict(s)
+            entry["vs_mean"] = round(s["ms"] / mean_ms, 2) \
+                if mean_ms > 0 else 0.0
+            dom = span_max.get((s["step"], s["host"]))
+            if dom is not None:
+                entry["dominant_span"] = dom[0]
+                entry["dominant_ms"] = round(dom[1] / 1000.0, 3)
+            slow_steps.append(entry)
+
+    return {
+        "hosts": sorted(traces),
+        "host_offsets_us": {str(h): round(o, 1)
+                            for h, o in offsets.items()},
+        "steps_covered": len(steps),
+        "mean_step_ms": (round(sum(s["ms"] for s in steps)
+                               / len(steps), 3) if steps else 0.0),
+        "slow_steps": slow_steps,
+        "traceEvents": merged,
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("trace_dir")
@@ -184,20 +336,34 @@ def main(argv=None):
                    help="raw Compiled.as_text() dump to build the "
                         "component map from (alternative to "
                         "--attribution)")
+    p.add_argument("--merge", action="store_true",
+                   help="treat the positional arg as a training "
+                        "logdir and merge its trace-host<i>.json "
+                        "span files into one step-aligned cross-host "
+                        "timeline (telemetry tracing, ISSUE 5)")
     args = p.parse_args(argv)
     try:
-        cmap = load_component_map(args.attribution, args.hlo)
-        summary = summarize(args.trace_dir, args.top,
-                            component_map=cmap)
+        if args.merge:
+            summary = merge_host_traces(args.trace_dir)
+        else:
+            cmap = load_component_map(args.attribution, args.hlo)
+            summary = summarize(args.trace_dir, args.top,
+                                component_map=cmap)
     except (FileNotFoundError, ValueError, OSError) as e:
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
         return 1
-    out = json.dumps(summary, indent=1)
-    print(out)
+    if args.merge:
+        # stdout gets the human-relevant verdict; the (large) merged
+        # timeline only lands where --out asks for it
+        printed = {k: v for k, v in summary.items()
+                   if k != "traceEvents"}
+        print(json.dumps(printed, indent=1))
+    else:
+        print(json.dumps(summary, indent=1))
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:
-            f.write(out + "\n")
+            f.write(json.dumps(summary, indent=1) + "\n")
     return 0
 
 
