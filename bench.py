@@ -243,6 +243,17 @@ def main(argv=None):
                    help="param + optimizer-state storage dtype "
                         "(TRAIN.PARAM_DTYPE); bfloat16 halves the "
                         "state HBM — the 1344/b8 memory plan")
+    p.add_argument("--sharding", default="replicated",
+                   choices=["replicated", "fsdp"],
+                   help="sharding plan for the measured train step "
+                        "(eksml_tpu/parallel/sharding.py): fsdp "
+                        "shards params+optimizer state over the fsdp "
+                        "mesh axis, gathered just-in-time in the "
+                        "step; per-device state bytes land in the "
+                        "result JSON either way")
+    p.add_argument("--fsdp-axis", type=int, default=0,
+                   help="fsdp axis size for --sharding fsdp "
+                        "(0 = all devices of one slice)")
     p.add_argument("--prefetch", type=int, default=-1,
                    choices=(-1, 0, 1),
                    help="input-pipeline A/B: -1 = one device-resident "
@@ -579,10 +590,26 @@ def run(args, diag: dict) -> None:
     cfg.TRAIN.REMAT = bool(args.remat)
     cfg.TRAIN.PARAM_DTYPE = getattr(args, "param_dtype", "float32")
     cfg.TRAIN.BATCH_SIZE_PER_CHIP = args.batch_size
+    cfg.TRAIN.SHARDING.STRATEGY = getattr(args, "sharding",
+                                          "replicated")
+    cfg.TRAIN.SHARDING.FSDP_AXIS_SIZE = getattr(args, "fsdp_axis", 0)
     cfg.PREPROC.MAX_SIZE = size
     cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (size, size)
     cfg.update_args(args.config)
     cfg.freeze()
+    # the config is the single source of truth for the measured plan:
+    # a --config TRAIN.SHARDING.* override lands AFTER the flags above
+    # and must actually select the plan (keying off the flag alone
+    # would bank a "fsdp" JSON line measured on the replicated path)
+    sharding = str(cfg.TRAIN.SHARDING.STRATEGY)
+    if sharding != "replicated":
+        if getattr(args, "forward_only", False):
+            raise ValueError("sharding=fsdp measures the full "
+                             "train step (params+optimizer shards); "
+                             "drop --forward-only")
+        if getattr(args, "prefetch", -1) >= 0:
+            raise ValueError("sharding and --prefetch are separate "
+                             "A/Bs; run them in separate invocations")
     # Validate AFTER update_args so a sweep overriding the strides is
     # checked against the strides it actually runs with.
     coarsest = max(cfg.FPN.ANCHOR_STRIDES)
@@ -606,6 +633,31 @@ def run(args, diag: dict) -> None:
     fwd_only = getattr(args, "forward_only", False)
     model = MaskRCNN.from_config(cfg)
 
+    # sharding plan for the measured step (--sharding): replicated
+    # keeps the historical no-mesh jit path untouched (banked numbers
+    # stay comparable); fsdp builds the (data, fsdp, model) mesh and
+    # threads the plan's shardings through init and the step
+    plan = None
+    if sharding != "replicated":
+        from eksml_tpu.parallel import build_mesh
+        from eksml_tpu.parallel.mesh import slice_groups
+        from eksml_tpu.parallel.sharding import ShardingPlan, plan_mesh
+
+        # the plan must see the real slice topology: with the config
+        # default NUM_SLICES=1, --fsdp-axis 0 on multislice hardware
+        # would resolve to ALL devices and straddle the DCN hop
+        groups = slice_groups(devices)
+        num_slices = len(groups) if groups else 1
+        if num_slices > 1:
+            cfg.freeze(False)
+            cfg.TPU.NUM_SLICES = num_slices
+            cfg.freeze()
+        mesh_shape, mesh_axes = plan_mesh(cfg, n_devices=n_dev)
+        mesh = build_mesh(mesh_shape, mesh_axes, devices,
+                          num_slices=num_slices)
+        plan = ShardingPlan.from_config(cfg, mesh)
+        diag["sharding"] = plan.describe()
+
     # input-pipeline A/B (--prefetch): a small pool of DISTINCT host
     # batches cycled through the step loop, so transfer modes measure
     # real per-step H2D traffic instead of a cached resident buffer
@@ -619,14 +671,28 @@ def run(args, diag: dict) -> None:
             for s in range(4)]
         batch = jax.device_put(host_batches[0])
     else:
-        batch = make_synthetic_batch(cfg, batch_size=args.batch_size,
+        # the plan path runs ONE global program over every device, so
+        # the host batch carries batch_size rows PER CHIP (the
+        # trainer's TRAIN.BATCH_SIZE_PER_CHIP semantics — the batch
+        # axis must divide over data×fsdp); the historical no-plan
+        # path keeps batch_size total rows on one device
+        global_bs = args.batch_size * (n_dev if plan is not None else 1)
+        batch = make_synthetic_batch(cfg, batch_size=global_bs,
                                      image_size=shape)
         batch = {k: jnp.asarray(v) for k, v in batch.items()
                  if k not in ("image_scale", "image_id")}
 
     rng = jax.random.PRNGKey(0)
     t0 = time.time()
-    params = jax.jit(lambda r, b: model.init(r, b, r)["params"])(rng, batch)
+
+    def init_fn(r, b):
+        return model.init(r, b, r)["params"]
+
+    if plan is not None:
+        batch = jax.device_put(batch, plan.batch_sharding())
+        params, param_sh = plan.init_sharded(init_fn, rng, batch)
+    else:
+        params = jax.jit(init_fn)(rng, batch)
     from eksml_tpu.train import cast_params_for_storage
 
     params = cast_params_for_storage(params, cfg.TRAIN.PARAM_DTYPE)
@@ -635,7 +701,18 @@ def run(args, diag: dict) -> None:
         # param-tree-sized momentum buffers on the device exactly where
         # per-cycle latency matters most (code review r5)
         tx, _ = make_optimizer(cfg)
-        opt_state = tx.init(params)
+        if plan is not None:
+            opt_state, opt_sh = plan.init_sharded(tx.init, params)
+        else:
+            opt_state = tx.init(params)
+        # the per-device state cost of the active plan — what the
+        # fsdp-vs-replicated A/B is actually about (the same numbers
+        # the trainer's eksml_train_*_bytes gauges publish)
+        from eksml_tpu.parallel.sharding import tree_bytes_per_device
+
+        diag["param_bytes_per_device"] = tree_bytes_per_device(params)
+        diag["opt_state_bytes_per_device"] = tree_bytes_per_device(
+            opt_state)
     print(f"bench: init in {time.time() - t0:.1f}s", file=sys.stderr)
 
     # per-step batch source for the transfer A/B modes
@@ -684,17 +761,30 @@ def run(args, diag: dict) -> None:
     else:
         def train_step(params, opt_state, batch, rng):
             def loss_fn(p):
+                if plan is not None:
+                    p = plan.compute_params(p)  # fsdp just-in-time gather
                 losses = model.apply({"params": p}, batch, rng)
                 return losses["total_loss"], losses
 
             grads, losses = jax.grad(loss_fn, has_aux=True)(params)
+            if plan is not None:
+                grads = plan.storage_grads(grads)  # reduce-scatter
             # scope → "optimizer" in the profiling attribution
             with jax.named_scope("optimizer"):
                 updates, new_opt = tx.update(grads, opt_state, params)
                 return (optax.apply_updates(params, updates), new_opt,
                         losses["total_loss"])
 
-        step = jax.jit(train_step, donate_argnums=(0, 1))
+        if plan is not None:
+            repl = plan.replicated()
+            step = plan.jit(
+                train_step,
+                in_shardings=(param_sh, opt_sh,
+                              plan.batch_sharding(), repl),
+                out_shardings=(param_sh, opt_sh, repl),
+                donate_argnums=(0, 1))
+        else:
+            step = jax.jit(train_step, donate_argnums=(0, 1))
         lower_args = (params, opt_state, batch, rng)
 
         def run_step(i):
@@ -763,7 +853,10 @@ def run(args, diag: dict) -> None:
             prefetcher.close()
 
     assert np.isfinite(float(loss)), f"non-finite loss {float(loss)}"
-    imgs_per_sec = args.steps * args.batch_size / dt
+    # under a plan each step consumes batch_size rows on EVERY chip;
+    # the legacy path's step is batch_size rows total
+    imgs_per_step = args.batch_size * (n_dev if plan is not None else 1)
+    imgs_per_sec = args.steps * imgs_per_step / dt
     per_chip = imgs_per_sec / max(1, n_dev)
     step_ms = dt / args.steps * 1000
 

@@ -210,6 +210,33 @@ TELEMETRY_DEFAULTS = dict(
     HEALTHZ_STALE_SEC=0.0,
 )
 
+# Sharding-plan knobs (eksml_tpu/parallel/sharding.py) — ONE source
+# of truth, same pattern as RESILIENCE_DATA_DEFAULTS: installed under
+# TRAIN.SHARDING, and sharding.sharding_knobs imports the same dict as
+# the fallback for pre-sharding config trees.
+#
+# - STRATEGY: how params + optimizer state lay out across the mesh.
+#   "replicated" = one full copy per chip (the reference's only
+#   strategy; today's default — compiled program unchanged).  "fsdp" =
+#   shard both over the fsdp mesh axis (ZeRO-style), gathered
+#   just-in-time inside the step via sharding constraints — the
+#   memory plan for R101/cascade at batch/image sizes the replicated
+#   layout can't fit.  "tensor" = model-axis rules only (skeleton;
+#   execution lands later, the plan refuses to compile).
+# - FSDP_AXIS_SIZE: devices on the fsdp axis (0 = every device of one
+#   slice).  Must divide the per-slice device count — param
+#   all-gathers are per-step traffic and must stay on ICI, never DCN.
+# - RULES: ordered ((regex, action), ...) partition rules matched
+#   against /-joined param-tree paths; action is "fsdp" (auto-place
+#   the axis on the largest divisible dim), "replicated", or a
+#   literal PartitionSpec tuple.  MUST end with a catch-all.  () =
+#   the strategy's defaults (sharding.DEFAULT_RULES).
+SHARDING_DEFAULTS = dict(
+    STRATEGY="replicated",
+    FSDP_AXIS_SIZE=0,
+    RULES=(),
+)
+
 # Span tracing + on-demand profiling knobs (telemetry/tracing.py),
 # installed under TELEMETRY.TRACING; train._tracing_knobs imports the
 # same dict as the fallback for pre-tracing config trees.
@@ -384,6 +411,10 @@ def _define_defaults() -> None:
     # data/prefetch_wait_ms.  False = legacy synchronous transfer.
     _C.TRAIN.PREFETCH_TO_DEVICE = True
     _C.TRAIN.LOGDIR = "/tmp/eksml_tpu/train_log/maskrcnn"
+    # sharding plan (eksml_tpu/parallel/sharding.py) — per-knob docs
+    # on SHARDING_DEFAULTS above
+    for k, v in SHARDING_DEFAULTS.items():
+        setattr(_C.TRAIN.SHARDING, k, v)
 
     # ---- TPU / comm layer (≙ HOROVOD_*/NCCL_* env, values.yaml:24-28)
     _C.TPU.MESH_SHAPE = ()         # () → (num_devices, 1)
@@ -489,6 +520,13 @@ def finalize_configs(is_training: bool) -> AttrDict:
         _C.TRAIN.PARAM_DTYPE)
     assert _C.RESILIENCE.DATA.VALIDATE in ("off", "warn", "strict"), (
         _C.RESILIENCE.DATA.VALIDATE)
+    # lazy import: ONE strategy inventory (sharding.py imports config
+    # only inside functions, so there is no cycle)
+    from eksml_tpu.parallel.sharding import STRATEGIES
+    assert _C.TRAIN.SHARDING.STRATEGY in STRATEGIES, (
+        _C.TRAIN.SHARDING.STRATEGY)
+    assert int(_C.TRAIN.SHARDING.FSDP_AXIS_SIZE) >= 0, (
+        _C.TRAIN.SHARDING.FSDP_AXIS_SIZE)
     assert len(_C.FPN.ANCHOR_STRIDES) == len(_C.RPN.ANCHOR_SIZES)
     assert _C.PREPROC.MAX_SIZE % max(_C.FPN.ANCHOR_STRIDES) == 0, (
         "padded image size must be divisible by the coarsest FPN stride")

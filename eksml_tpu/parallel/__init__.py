@@ -18,3 +18,6 @@ from eksml_tpu.parallel.distributed import (  # noqa: F401
 from eksml_tpu.parallel.collectives import (  # noqa: F401
     cross_host_sum, param_fingerprint, set_xla_collective_flags,
     warm_mesh_collectives)
+from eksml_tpu.parallel.sharding import (  # noqa: F401
+    ShardingPlan, match_partition_rules, plan_mesh,
+    tree_bytes_per_device)

@@ -158,8 +158,23 @@ def build_mesh(mesh_shape: Sequence[int] = (),
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    axis_names = tuple(axis_names)
+    mesh_shape = tuple(int(s) for s in mesh_shape)
     if not mesh_shape:
         mesh_shape = (n,) + (1,) * (len(axis_names) - 1)
+    # Validate the requested axes against the real device count HERE,
+    # with errors naming the knobs — a bad model/fsdp axis size used
+    # to surface as a reshape/shape error deep inside jit.
+    if len(mesh_shape) != len(axis_names):
+        raise ValueError(
+            f"mesh shape {mesh_shape} has {len(mesh_shape)} entries "
+            f"for {len(axis_names)} axes {axis_names} — "
+            "TPU.MESH_SHAPE and TPU.MESH_AXES must be the same "
+            "length (one size per axis)")
+    if any(s < 1 for s in mesh_shape):
+        raise ValueError(
+            f"mesh shape {mesh_shape}: every axis size must be >= 1 "
+            f"(axes {axis_names}); use 1 for an unused axis")
     need = int(np.prod(mesh_shape))
     groups = slice_groups(devices)
     if groups is not None:
@@ -206,13 +221,24 @@ def build_mesh(mesh_shape: Sequence[int] = (),
                 f"multi-slice mesh must cover all {n} devices "
                 f"(shape {tuple(mesh_shape)} covers {need})")
         if mesh_shape[0] % num_slices:
+            # this is also what keeps the trailing (fsdp/model) axes
+            # INSIDE one slice: with slice-major device order, each
+            # data index owns one contiguous block of trailing-axes
+            # devices, and data % slices == 0 ⇔ that block never
+            # straddles a slice boundary (no DCN hop inside an
+            # fsdp/TP group)
             raise ValueError(
                 f"data axis {mesh_shape[0]} does not split over "
-                f"{num_slices} slices")
+                f"{num_slices} slices; the trailing axes "
+                f"{tuple(axis_names[1:])} (sizes {mesh_shape[1:]}) "
+                "must divide each slice's device count")
     if need > n:
         raise ValueError(
-            f"mesh shape {tuple(mesh_shape)} needs {need} devices, "
-            f"have {n}")
+            f"mesh shape {tuple(mesh_shape)} over axes {axis_names} "
+            f"needs {need} devices, have {n} — the product of the "
+            "axis sizes (TPU.MESH_SHAPE / "
+            "TRAIN.SHARDING.FSDP_AXIS_SIZE) must not exceed the "
+            "device count")
     if need < n and jax.process_count() > 1:
         # a subset mesh would leave some hosts' devices unrepresented —
         # their jit calls fail or hang at the first collective

@@ -1,0 +1,496 @@
+"""Pluggable sharding-plan compiler: partition rules → compiled steps.
+
+The reference stack has exactly one parallelism strategy — a full
+model replica per accelerator (Horovod DP, SURVEY.md §2c) — and until
+this module so did we: ``Trainer.compiled_step`` hard-coded
+``PartitionSpec("data")`` batches against fully-replicated state, and
+the ``model`` mesh axis sat reserved at size 1.  This module makes the
+layout a *config knob* instead of a code path:
+
+- **Partition-rule engine** (``match_partition_rules``): an ordered
+  list of ``(regex, action)`` rules matched with ``re.search`` against
+  ``/``-joined pytree paths (``backbone/conv0/kernel``,
+  ``0/trace/fpn/lateral_2/kernel`` — optimizer momentum mirrors the
+  param paths, so one rule set claims both).  First match wins; the
+  list MUST end with a catch-all; scalars never partition.  The same
+  idea as the ``match_partition_rules`` regex→PartitionSpec engines in
+  the LLM-training world (SNIPPETS.md [1]), adapted for a convnet's
+  heterogeneous ranks: besides a literal ``PartitionSpec`` tuple, an
+  action may be the string ``"fsdp"`` (place the fsdp axis on the
+  largest evenly-divisible dim; fall back to replicated when none
+  divides) or ``"replicated"``.
+
+- **``ShardingPlan``** (SNIPPETS.md [3]'s compile-with-plan layer):
+  one object that owns the strategy name, the rules, the batch spec,
+  and the jit wrapper, so train/bench/dryrun ask the *plan* for
+  in/out shardings instead of hard-coding them.  Strategies:
+
+  * ``replicated`` — today's behavior, the default.  Specs are all
+    ``P()``; ``compute_params``/``storage_grads`` are identity, so
+    the compiled program is unchanged (loss streams stay
+    bit-identical with existing runs).
+  * ``fsdp`` — params AND optimizer state shard over the ``fsdp``
+    mesh axis (ZeRO-style).  Inside the step the params are gathered
+    just-in-time via a sharding constraint, gradients are constrained
+    back to the storage layout (XLA emits the all-gather /
+    reduce-scatter pair), and the optimizer update runs on shards.
+    Per-device *persistent* state drops by ~the axis size; transient
+    gather buffers are scheduled by XLA near their use.
+  * ``tensor`` — rules only (the ``model`` axis > 1 skeleton);
+    ``jit`` refuses with a clear NotImplementedError until execution
+    lands.
+
+``plan_mesh`` turns the ``TRAIN.SHARDING.*`` knobs into a
+``(mesh_shape, axis_names)`` pair for :func:`build_mesh`, inserting
+the ``fsdp`` axis between ``data`` and ``model`` and validating the
+axis size against the per-slice device count — the fsdp all-gathers
+are per-step traffic and must ride ICI, never a DCN hop.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger(__name__)
+
+STRATEGIES = ("replicated", "fsdp", "tensor")
+
+#: rule actions (besides a literal PartitionSpec tuple)
+REPLICATED = "replicated"
+FSDP_AUTO = "fsdp"
+
+# Strategy-default rule sets (TRAIN.SHARDING.RULES=() selects these).
+# fsdp shards EVERY leaf with a divisible dim — biases and norm scales
+# included, exactly like ZeRO — because the catch-all's auto placement
+# already degrades to replicated for the leaves that cannot split.
+DEFAULT_RULES: Dict[str, Tuple[Tuple[str, Any], ...]] = {
+    "replicated": ((r".*", REPLICATED),),
+    "fsdp": ((r".*", FSDP_AUTO),),
+    # tensor skeleton: shard the big head/FPN matmuls' output features
+    # over the model axis, replicate the rest.  Rules are real and
+    # testable; execution (activation specs, collective placement)
+    # lands in a later PR — ShardingPlan.jit refuses until then.
+    "tensor": (
+        (r"(fc6|fc7|fc_head|frcnn_fc)\w*/kernel$", (None, "model")),
+        (r".*", REPLICATED),
+    ),
+}
+
+# two probes approximating "matches any path": a multi-segment
+# nonsense path and a bare leaf name.  A last rule that misses either
+# is not a catch-all (e.g. "kernel$"), and the engine would raise on
+# the first unclaimed leaf deep inside trainer init — fail at plan
+# construction instead, naming the fix.
+_CATCHALL_PROBES = ("zz9/plural/z/alpha", "leaf")
+
+
+def _key_str(k) -> str:
+    """One pytree KeyEntry → path segment."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def tree_path_str(path: Sequence) -> str:
+    """Pytree key path → ``a/b/c`` string the rule regexes match."""
+    return "/".join(_key_str(k) for k in path)
+
+
+def validate_rules(rules) -> Tuple[Tuple[str, Any], ...]:
+    """Normalize + validate an ordered rule list.
+
+    Each rule is ``(pattern, action)`` with action one of
+    ``"replicated"``, ``"fsdp"``, or a tuple of PartitionSpec entries
+    (``None`` / axis name / tuple of axis names).  The last rule must
+    be a catch-all — every leaf must be *claimed*, never defaulted.
+    """
+    try:
+        rules = tuple(
+            (str(p), a if isinstance(a, str) else tuple(a))
+            for p, a in rules)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"partition rules must be (pattern, action) pairs, got "
+            f"{rules!r}") from e
+    if not rules:
+        raise ValueError(
+            "partition rules are empty — need at least a catch-all "
+            "like ('.*', 'replicated')")
+    for pat, action in rules:
+        try:
+            re.compile(pat)
+        except re.error as e:
+            raise ValueError(
+                f"partition rule pattern {pat!r} is not a valid "
+                f"regex: {e}") from e
+        if isinstance(action, str):
+            if action not in (REPLICATED, FSDP_AUTO):
+                raise ValueError(
+                    f"partition rule {pat!r}: string action must be "
+                    f"'replicated' or 'fsdp', got {action!r}")
+        else:
+            for entry in action:
+                ok = entry is None or isinstance(entry, str) or (
+                    isinstance(entry, tuple)
+                    and all(isinstance(x, str) for x in entry))
+                if not ok:
+                    raise ValueError(
+                        f"partition rule {pat!r}: spec entry "
+                        f"{entry!r} must be None, an axis name, or a "
+                        "tuple of axis names")
+    last = rules[-1][0]
+    if not all(re.search(last, probe) for probe in _CATCHALL_PROBES):
+        raise ValueError(
+            f"partition rules must end with a catch-all pattern that "
+            f"claims every remaining leaf (e.g. ('.*', 'replicated')); "
+            f"the last rule {last!r} does not match everything")
+    return rules
+
+
+def _auto_fsdp_spec(shape: Tuple[int, ...], axis_size: int,
+                    axis_name: str) -> Optional[P]:
+    """Place ``axis_name`` on the largest dim divisible by
+    ``axis_size``; None when no dim divides (caller replicates)."""
+    order = sorted(range(len(shape)), key=lambda i: (-shape[i], i))
+    for i in order:
+        if shape[i] >= axis_size and shape[i] % axis_size == 0:
+            # trailing Nones dropped: P('fsdp') == the canonical form
+            return P(*([None] * i), axis_name)
+    return None
+
+
+def _match_leaf(path: str, leaf, rules, mesh_axes: Dict[str, int],
+                axis_size: int, fsdp_axis: str) -> Tuple[P, str]:
+    """→ (PartitionSpec, why) for one leaf.  ``why`` names the rule
+    (or guard) that claimed it — the explain() payload."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    if len(shape) == 0 or int(np.prod(shape)) == 1:
+        return P(), "(scalar)"
+    for pat, action in rules:
+        if re.search(pat, path) is None:
+            continue
+        if action == REPLICATED:
+            return P(), pat
+        if action == FSDP_AUTO:
+            spec = _auto_fsdp_spec(shape, axis_size, fsdp_axis)
+            if spec is None:
+                return P(), f"{pat} (no dim divisible by " \
+                            f"{fsdp_axis}={axis_size}; replicated)"
+            return spec, pat
+        # literal PartitionSpec tuple
+        if len(action) > len(shape):
+            raise ValueError(
+                f"partition rule {pat!r} spec {action!r} has "
+                f"{len(action)} entries but {path!r} has rank "
+                f"{len(shape)} (shape {shape})")
+        for dim, entry in enumerate(action):
+            if entry is None:
+                continue
+            axes = (entry,) if isinstance(entry, str) else entry
+            div = 1
+            for a in axes:
+                if a not in mesh_axes:
+                    raise ValueError(
+                        f"partition rule {pat!r} names mesh axis "
+                        f"{a!r} but the mesh has axes "
+                        f"{tuple(mesh_axes)}")
+                div *= mesh_axes[a]
+            if shape[dim] % div:
+                raise ValueError(
+                    f"partition rule {pat!r}: {path!r} dim {dim} "
+                    f"(size {shape[dim]}) does not divide over "
+                    f"{entry!r} (axis size {div})")
+        return P(*action), pat
+    raise ValueError(
+        f"no partition rule matched leaf {path!r} — the rule list "
+        "must end with a catch-all like ('.*', 'replicated')")
+
+
+def match_partition_rules(rules, tree, mesh: Mesh,
+                          fsdp_axis: str = "fsdp"):
+    """Pytree of PartitionSpec from ordered rules (first match wins).
+
+    Accepts arrays or ShapeDtypeStructs.  Raises on an unclaimed leaf;
+    pre-validate with :func:`validate_rules` for the earlier,
+    friendlier catch-all error.
+    """
+    mesh_axes = dict(mesh.shape)
+    axis_size = int(mesh_axes.get(fsdp_axis, 1))
+
+    def one(path, leaf):
+        spec, _ = _match_leaf(tree_path_str(path), leaf, rules,
+                              mesh_axes, axis_size, fsdp_axis)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def tree_bytes_per_device(tree) -> int:
+    """Per-device bytes of a (possibly sharded) array pytree.
+
+    Committed jax.Arrays report their actual shard shape; abstract
+    leaves without a sharding count their full size (= replicated).
+    """
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(shape)
+        total += int(np.prod(shape)) * np.dtype(dtype).itemsize
+    return total
+
+
+def publish_state_byte_gauges(params, opt_state) -> Tuple[int, int]:
+    """Per-device param/optimizer-state bytes → the
+    ``eksml_train_param_bytes`` / ``eksml_train_opt_state_bytes``
+    gauges.  ONE definition of the names + help strings for trainer
+    and dryrun alike (a rename in one site must not desynchronize
+    /metrics).  Returns ``(param_bytes, opt_bytes)``."""
+    from eksml_tpu import telemetry
+
+    pb = tree_bytes_per_device(params)
+    ob = tree_bytes_per_device(opt_state)
+    registry = telemetry.default_registry()
+    registry.gauge(
+        "eksml_train_param_bytes",
+        "per-device parameter bytes under the active sharding "
+        "plan").set(float(pb))
+    registry.gauge(
+        "eksml_train_opt_state_bytes",
+        "per-device optimizer-state bytes under the active "
+        "sharding plan").set(float(ob))
+    return pb, ob
+
+
+def sharding_knobs(cfg) -> Dict[str, Any]:
+    """``TRAIN.SHARDING.*`` values over the canonical defaults —
+    config trees predating the knobs keep working (the
+    ``_knobs_with_fallback`` pattern, train.py)."""
+    from eksml_tpu.config import SHARDING_DEFAULTS
+
+    out = dict(SHARDING_DEFAULTS)
+    node = getattr(getattr(cfg, "TRAIN", None), "SHARDING", None)
+    if node is not None and hasattr(node, "to_dict"):
+        for k in out:
+            v = getattr(node, k, None)
+            if v is not None and not hasattr(v, "to_dict"):
+                out[k] = v
+    return out
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def plan_mesh(cfg, n_devices: Optional[int] = None
+              ) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    """``TRAIN.SHARDING.*`` + ``TPU.MESH_*`` → (mesh_shape, axes) for
+    :func:`build_mesh`.
+
+    ``replicated``/``tensor`` keep the legacy mesh untouched (tensor
+    execution lands later; its model axis stays 1 until then).  For
+    ``fsdp`` the axis is inserted between ``data`` and the rest, sized
+    by ``FSDP_AXIS_SIZE`` (0 = every device of one slice), and
+    validated against the per-slice device count — parameter
+    all-gathers are per-step traffic and must stay on ICI, so a shard
+    group may never straddle a DCN hop.  An explicit operator
+    ``TPU.MESH_SHAPE`` always wins (but must name the fsdp axis).
+    """
+    knobs = sharding_knobs(cfg)
+    strategy = str(knobs["STRATEGY"])
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            f"TRAIN.SHARDING.STRATEGY={strategy!r} is not one of "
+            f"{STRATEGIES}")
+    shape = tuple(int(s) for s in cfg.TPU.MESH_SHAPE)
+    axes = tuple(cfg.TPU.MESH_AXES)
+    if strategy != "fsdp":
+        return shape, axes
+    if "fsdp" not in axes:
+        if shape:
+            raise ValueError(
+                f"TRAIN.SHARDING.STRATEGY=fsdp needs an 'fsdp' mesh "
+                f"axis, but the explicit TPU.MESH_SHAPE={shape} / "
+                f"TPU.MESH_AXES={axes} does not name one — add it "
+                "(e.g. MESH_AXES=('data','fsdp','model')) or clear "
+                "MESH_SHAPE to derive the mesh from the knobs")
+        axes = axes[:1] + ("fsdp",) + axes[1:]
+    if shape:
+        return shape, axes
+    n = n_devices if n_devices else len(jax.devices())
+    num_slices = max(1, int(getattr(cfg.TPU, "NUM_SLICES", 1)))
+    if n % num_slices:
+        raise ValueError(
+            f"{n} device(s) do not split into TPU.NUM_SLICES="
+            f"{num_slices}")
+    per_slice = n // num_slices
+    f = int(knobs["FSDP_AXIS_SIZE"]) or per_slice
+    if f < 1 or per_slice % f:
+        raise ValueError(
+            f"TRAIN.SHARDING.FSDP_AXIS_SIZE={f} is invalid for {n} "
+            f"device(s) in {num_slices} slice(s) ({per_slice} per "
+            f"slice): the fsdp axis must divide the per-slice device "
+            f"count so parameter shards never straddle a DCN hop; "
+            f"valid sizes here: {_divisors(per_slice)}")
+    # size axes BY NAME: an operator MESH_AXES ordering the fsdp axis
+    # anywhere but index 1 must still get its size (positional sizing
+    # silently left fsdp at 1 — a fully-replicated run claiming fsdp)
+    return tuple(n // f if a == "data" else f if a == "fsdp" else 1
+                 for a in axes), axes
+
+
+class ShardingPlan:
+    """Strategy + rules + mesh → shardings and compiled steps.
+
+    The Titanax-style compile-with-plan layer (SNIPPETS.md [3]): the
+    trainer/bench never names a PartitionSpec — it asks the plan.
+    """
+
+    def __init__(self, strategy: str, mesh: Mesh, rules=(),
+                 fsdp_axis: str = "fsdp"):
+        if strategy not in STRATEGIES:
+            raise ValueError(
+                f"unknown sharding strategy {strategy!r}; valid: "
+                f"{STRATEGIES} (TRAIN.SHARDING.STRATEGY)")
+        self.strategy = strategy
+        self.mesh = mesh
+        self.fsdp_axis = fsdp_axis
+        mesh_axes = dict(mesh.shape)
+        if strategy == "fsdp" and fsdp_axis not in mesh_axes:
+            raise ValueError(
+                f"sharding strategy 'fsdp' needs a {fsdp_axis!r} mesh "
+                f"axis; this mesh has {tuple(mesh.axis_names)} — "
+                "build it via plan_mesh(cfg) (train.py does)")
+        self.axis_size = int(mesh_axes.get(fsdp_axis, 1))
+        self.rules = validate_rules(rules or DEFAULT_RULES[strategy])
+        batch_axes = tuple(a for a in ("data", fsdp_axis)
+                           if a in mesh_axes)
+        #: batch rows split over data (and, when present, fsdp — the
+        #: two together are "all the replicas"); the spec
+        #: _globalize_batch and bench both use
+        self.batch_spec = (P(batch_axes[0]) if len(batch_axes) == 1
+                           else P(batch_axes))
+
+    @classmethod
+    def from_config(cls, cfg, mesh: Mesh) -> "ShardingPlan":
+        k = sharding_knobs(cfg)
+        return cls(str(k["STRATEGY"]), mesh,
+                   rules=tuple(k["RULES"] or ()))
+
+    # -- specs / shardings --------------------------------------------
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec)
+
+    def specs(self, tree):
+        """PartitionSpec pytree for params / optimizer state / grads.
+        Paths are matched as-is — momentum leaves carry the param path
+        as a suffix, so one rule set claims both."""
+        if self.strategy == "replicated":
+            return jax.tree.map(lambda _: P(), tree)
+        return match_partition_rules(self.rules, tree, self.mesh,
+                                     fsdp_axis=self.fsdp_axis)
+
+    def shardings(self, tree):
+        """NamedSharding pytree (what jit/device_put consume)."""
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self.specs(tree))
+
+    def init_sharded(self, fn, *args):
+        """Run ``fn(*args)`` jitted with the plan's shardings over its
+        abstract output → ``(value, shardings)``.  State is BORN in
+        its storage layout — no device ever holds a replicated copy it
+        would immediately shard.  ONE definition of the
+        eval_shape→shardings→out_shardings idiom for trainer, bench
+        and dryrun (three hand-rolled copies could drift and measure
+        different layouts under the same plan name)."""
+        sh = self.shardings(jax.eval_shape(fn, *args))
+        return jax.jit(fn, out_shardings=sh)(*args), sh
+
+    # -- inside-the-step constraints ----------------------------------
+
+    def compute_params(self, params):
+        """FSDP: gather the param shards just-in-time for compute (a
+        replication constraint XLA lowers to all-gathers near use).
+        Identity under ``replicated`` — the program is unchanged."""
+        if self.strategy == "replicated":
+            return params
+        return jax.lax.with_sharding_constraint(params,
+                                                self.replicated())
+
+    def storage_grads(self, grads):
+        """FSDP: constrain gradients back to the storage layout (XLA
+        lowers the psum+slice to a reduce-scatter), so the optimizer
+        update runs on shards.  Identity under ``replicated``."""
+        if self.strategy == "replicated":
+            return grads
+        return jax.lax.with_sharding_constraint(grads,
+                                                self.shardings(grads))
+
+    # -- compile ------------------------------------------------------
+
+    def jit(self, fn, **jit_kwargs):
+        """``jax.jit`` behind the plan: the single place strategy
+        executability is enforced (SNIPPETS.md [3])."""
+        if self.strategy == "tensor":
+            raise NotImplementedError(
+                "sharding strategy 'tensor': partition rules are "
+                "defined (model axis specs) but step execution has "
+                "not landed yet — use 'replicated' or 'fsdp'")
+        return jax.jit(fn, **jit_kwargs)
+
+    # -- introspection ------------------------------------------------
+
+    def explain(self, tree, title: str = "tree") -> str:
+        """Which rule claimed each leaf, with per-device bytes — the
+        dump that answers 'why is this leaf replicated?'."""
+        mesh_axes = dict(self.mesh.shape)
+        rows = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                tree)[0]:
+            p = tree_path_str(path)
+            if self.strategy == "replicated":
+                spec, why = P(), "(strategy: replicated)"
+            else:
+                spec, why = _match_leaf(p, leaf, self.rules,
+                                        mesh_axes, self.axis_size,
+                                        self.fsdp_axis)
+            shape = tuple(getattr(leaf, "shape", ()))
+            div = 1
+            for entry in spec:
+                for a in ((entry,) if isinstance(entry, str)
+                          else entry or ()):
+                    div *= mesh_axes.get(a, 1)
+            nbytes = (int(np.prod(shape))
+                      * np.dtype(leaf.dtype).itemsize
+                      if hasattr(leaf, "dtype") else 0)
+            rows.append((p, str(spec), why, nbytes // max(1, div)))
+        width = max((len(r[0]) for r in rows), default=4)
+        out = [f"sharding plan '{self.strategy}' over mesh "
+               f"{dict(self.mesh.shape)} — {title} "
+               f"({len(rows)} leaves):"]
+        for p, spec, why, b in rows:
+            out.append(f"  {p:<{width}}  {spec:<24} "
+                       f"{b / 2**20:8.2f} MiB/dev  <- {why}")
+        return "\n".join(out)
+
+    def describe(self) -> str:
+        """One-line summary for logs and bench diagnostics."""
+        if self.strategy == "fsdp":
+            return (f"fsdp(axis={self.axis_size}, "
+                    f"rules={len(self.rules)})")
+        return self.strategy

@@ -42,9 +42,11 @@ from flax import struct
 from eksml_tpu.config import config as global_config
 from eksml_tpu.config import config_from_env, finalize_configs
 from eksml_tpu.models import MaskRCNN
-from eksml_tpu.parallel import (batch_sharding, build_mesh,
-                                initialize_from_env, replicated_sharding,
-                                validate_topology, warm_mesh_collectives)
+from eksml_tpu.parallel import (build_mesh, initialize_from_env,
+                                replicated_sharding, validate_topology,
+                                warm_mesh_collectives)
+from eksml_tpu.parallel.sharding import (ShardingPlan, plan_mesh,
+                                         publish_state_byte_gauges)
 from eksml_tpu.parallel.collectives import set_xla_collective_flags
 from eksml_tpu.resilience import (HangWatchdog, PreemptedError,
                                   PreemptionHandler)
@@ -254,8 +256,12 @@ class Trainer:
                                      if cfg.TRAIN.NUM_CHIPS > 1 else None),
                           chips_per_host=cfg.TRAIN.CHIPS_PER_HOST,
                           num_slices=cfg.TPU.NUM_SLICES)
-        self.mesh = build_mesh(tuple(cfg.TPU.MESH_SHAPE),
-                               tuple(cfg.TPU.MESH_AXES),
+        # the sharding plan decides the mesh axes: replicated keeps
+        # the legacy (data, model) layout untouched; fsdp inserts the
+        # fsdp axis and sizes it from TRAIN.SHARDING.FSDP_AXIS_SIZE
+        # (parallel/sharding.py plan_mesh)
+        mesh_shape, mesh_axes = plan_mesh(cfg)
+        self.mesh = build_mesh(mesh_shape, mesh_axes,
                                num_slices=cfg.TPU.NUM_SLICES)
         # Horovod-style init allreduce: connect this mesh's collective
         # channels NOW, while all hosts are barrier-aligned — the lazy
@@ -303,8 +309,18 @@ class Trainer:
         self.ckpt = CheckpointManager(
             logdir, digest=cfg.RESILIENCE.CHECKPOINT_DIGEST)
 
-        self._batch_sharding = batch_sharding(self.mesh)
-        self._state_sharding = replicated_sharding(self.mesh)
+        # the plan owns every layout decision: batch spec, state
+        # specs, and (via plan.jit) strategy executability — the
+        # hard-coded PartitionSpec("data") / replicated pair is gone
+        self.plan = ShardingPlan.from_config(cfg, self.mesh)
+        if jax.process_index() == 0:
+            log.info("sharding plan: %s over mesh %s",
+                     self.plan.describe(), dict(self.mesh.shape))
+        self._batch_sharding = self.plan.batch_sharding()
+        self._replicated = replicated_sharding(self.mesh)
+        # refined to the plan's per-leaf tree once init_state knows
+        # the state structure
+        self._state_sharding = self._replicated
         self._jit_step = None
 
     # -- state ---------------------------------------------------------
@@ -312,28 +328,71 @@ class Trainer:
     def init_state(self, example_batch: Dict[str, np.ndarray]) -> TrainState:
         rng = jax.random.PRNGKey(self.cfg.TRAIN.SEED)
         sample = jax.tree.map(jnp.asarray, example_batch)
-        params = jax.jit(
-            lambda r, b: self.model.init(r, b, r)["params"],
-            out_shardings=self._state_sharding)(rng, sample)
+
+        def init_fn(r, b):
+            return self.model.init(r, b, r)["params"]
+
+        params, param_sh = self.plan.init_sharded(init_fn, rng, sample)
         if self.cfg.BACKBONE.WEIGHTS:
-            params = self._load_backbone(params)
+            params = self._load_backbone(params, param_sh)
         params = cast_params_for_storage(
             params, getattr(self.cfg.TRAIN, "PARAM_DTYPE", "float32"))
-        opt_state = self.tx.init(params)
+        opt_state, opt_sh = self.plan.init_sharded(self.tx.init,
+                                                   params)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=opt_state, rng=rng)
-        return jax.device_put(state, self._state_sharding)
+        self._state_sharding = TrainState(
+            step=self._replicated, params=param_sh,
+            opt_state=opt_sh, rng=self._replicated)
+        state = jax.device_put(state, self._state_sharding)
+        self._publish_memory_budget(state)
+        return state
 
-    def _load_backbone(self, params):
+    def _publish_memory_budget(self, state: TrainState) -> None:
+        """One log line + two gauges per (re)init: the per-device
+        cost of the state under the ACTIVE plan, so replicated-vs-fsdp
+        runs are comparable from logs or /metrics alone."""
+        pb, ob = publish_state_byte_gauges(state.params,
+                                           state.opt_state)
+        log.info(
+            "memory budget/device: params %.2f MiB + optimizer state "
+            "%.2f MiB (param_dtype=%s, sharding=%s)",
+            pb / 2**20, ob / 2**20,
+            getattr(self.cfg.TRAIN, "PARAM_DTYPE", "float32"),
+            self.plan.describe())
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("%s", self.plan.explain(state.params, "params"))
+
+    def _load_backbone(self, params, param_sh):
         from eksml_tpu.models import load_r50_npz
 
-        host = jax.tree.map(np.asarray, params)
-        bb = host["backbone"]
+        # gather ONLY the backbone subtree to replicated (under fsdp
+        # the shards can live on other hosts' devices, where a bare
+        # np.asarray would fail); a full-tree gather would put a
+        # complete replica on every device and hand back the init-time
+        # memory win in exactly the configs fsdp exists for
+        bb = jax.tree.map(
+            np.asarray,
+            jax.device_put(params["backbone"], self._replicated))
         bb, loaded, expected = load_r50_npz(self.cfg.BACKBONE.WEIGHTS, bb)
         log.info("backbone weights: loaded %d/%d arrays from %s",
                  loaded, expected, self.cfg.BACKBONE.WEIGHTS)
-        host["backbone"] = bb
-        return jax.device_put(host, self._state_sharding)
+        params = dict(params)
+        params["backbone"] = jax.device_put(bb, param_sh["backbone"])
+        return params
+
+    def _alt_restore_target(self, state):
+        """Replicated-layout restore target for
+        ``restore_with_fallback`` — the sharding-plan bridge a
+        checkpoint committed under another plan restores through
+        (both at startup and in the mid-run divergence rollback).
+        None under the replicated plan (no alternate exists)."""
+        if self.plan.strategy == "replicated":
+            return None
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=self._replicated),
+            state)
 
     def restore_or_init(self, example_batch) -> Tuple[TrainState, int]:
         """Auto-resume from the newest *verified* Orbax step (the
@@ -343,9 +402,17 @@ class Trainer:
         truncated on the shared filesystem, so each candidate is
         integrity-checked (resilience/integrity.py manifests) and the
         restore walks back to the newest good step instead of crashing
-        the relaunch."""
+        the relaunch.
+
+        Plan-aware: the restore targets carry the plan's shardings, so
+        a sharded plan restores shard-by-shard with no full gather.
+        When the plan is NOT replicated, a replicated-layout fallback
+        target rides along — a checkpoint an older (replicated) run
+        committed still restores even when the plan-sharded restore
+        cannot, and the device_put below re-applies the plan's specs."""
         state = self.init_state(example_batch)
-        restored = self.ckpt.restore_with_fallback(state)
+        restored = self.ckpt.restore_with_fallback(
+            state, alt_state_like=self._alt_restore_target(state))
         if restored is not None:
             good, good_step = restored
             log.info("resuming from checkpoint step %d", good_step)
@@ -360,10 +427,16 @@ class Trainer:
         step_rng = jax.random.fold_in(state.rng, state.step)
 
         def loss_fn(params):
+            # FSDP: gather the param shards just-in-time for compute
+            # (identity under replicated — program unchanged)
+            params = self.plan.compute_params(params)
             losses = self.model.apply({"params": params}, batch, step_rng)
             return losses["total_loss"], losses
 
         grads, losses = jax.grad(loss_fn, has_aux=True)(state.params)
+        # FSDP: back to the storage layout (reduce-scatter), so the
+        # optimizer below updates shards, not full copies
+        grads = self.plan.storage_grads(grads)
         # scope → the "optimizer" attribution component
         # (eksml_tpu/profiling SCOPE_RULES)
         with jax.named_scope("optimizer"):
@@ -391,10 +464,13 @@ class Trainer:
             # allows batch-4/chip and the async-save snapshot is a
             # real D2H copy, so it stays.
             donate = () if jax.default_backend() == "cpu" else (0,)
-            self._jit_step = jax.jit(
+            # the PLAN supplies the in/out shardings (per-leaf trees
+            # under fsdp, the legacy replicated pair otherwise) and
+            # refuses un-executable strategies (tensor skeleton)
+            self._jit_step = self.plan.jit(
                 self._train_step,
                 in_shardings=(self._state_sharding, self._batch_sharding),
-                out_shardings=(self._state_sharding, self._state_sharding),
+                out_shardings=(self._state_sharding, self._replicated),
                 donate_argnums=donate)
         return self._jit_step
 
@@ -419,7 +495,7 @@ class Trainer:
             from jax.experimental import multihost_utils
 
             return multihost_utils.host_local_array_to_global_array(
-                batch, self.mesh, jax.sharding.PartitionSpec("data"))
+                batch, self.mesh, self.plan.batch_spec)
         return jax.device_put(batch, self._batch_sharding)
 
     def fit(self, batches: Iterator[Dict[str, np.ndarray]],
@@ -462,6 +538,17 @@ class Trainer:
         eval_every = max(1, cfg.TRAIN.EVAL_PERIOD) * steps_per_epoch
         imgs_per_step = (cfg.TRAIN.BATCH_SIZE_PER_CHIP *
                          max(1, cfg.TRAIN.NUM_CHIPS))
+        sync_every = cfg.TRAIN.SYNC_CHECK_PERIOD
+        if sync_every and self.plan.strategy != "replicated":
+            # the replica sync check fingerprints per-device LOCAL
+            # shards assuming replication; under a sharded plan the
+            # shards legitimately differ and the check would either
+            # false-alarm or silently gather
+            log.warning("TRAIN.SYNC_CHECK_PERIOD disabled: the "
+                        "replica sync check assumes replicated "
+                        "params (sharding strategy %r)",
+                        self.plan.strategy)
+            sync_every = 0
 
         preempt = None
         if res.GRACEFUL_SHUTDOWN:
@@ -805,7 +892,6 @@ class Trainer:
                              total_steps, metrics["total_loss"],
                              metrics["images_per_sec"])
 
-                sync_every = cfg.TRAIN.SYNC_CHECK_PERIOD
                 if sync_every and step % sync_every == 0:
                     from eksml_tpu.parallel.collectives import \
                         assert_replicas_in_sync
@@ -982,7 +1068,8 @@ class Trainer:
             # exceeds a step-sized deadline — this is recovery, not a
             # hang
             watchdog.beat("rollback_restore", step)
-        restored = self.ckpt.restore_with_fallback(state)
+        restored = self.ckpt.restore_with_fallback(
+            state, alt_state_like=self._alt_restore_target(state))
         if restored is None:
             raise sentinel.no_checkpoint_to_restore(step)
         good, good_step = restored
@@ -1046,8 +1133,14 @@ class Trainer:
 
     def _run_eval(self, state, step):
         try:
+            params = state.params
+            if self.plan.strategy != "replicated":
+                # the eval/predict stack jits its own programs against
+                # plain replicated params — hand it a gathered copy
+                # rather than leaking the training layout into it
+                params = jax.device_put(params, self._replicated)
             with telemetry.span("eval", step=step):
-                results = self.eval_fn(self.model, state.params, step)
+                results = self.eval_fn(self.model, params, step)
             if results and self.writer:
                 self.writer.write_scalars(
                     step, {f"val/{k}": v for k, v in results.items()})

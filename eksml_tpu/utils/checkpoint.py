@@ -110,9 +110,20 @@ class CheckpointManager:
                 step, args=ocp.args.StandardRestore(abstract))
 
     def restore_with_fallback(
-            self, state_like: Any) -> Optional[Tuple[Any, int]]:
+            self, state_like: Any,
+            alt_state_like: Any = None) -> Optional[Tuple[Any, int]]:
         """Restore the newest step that passes integrity verification
         AND deserializes; walk back through older steps on failure.
+
+        ``alt_state_like``: optional second restore target with an
+        alternate sharding layout (the sharding plan's
+        replicated↔fsdp bridge, train.py ``restore_or_init``).  When
+        the primary restore of a step fails on any host, every host
+        retries that step under the alternate layout TOGETHER before
+        the corruption-vs-systematic verdict — a checkpoint committed
+        under another plan is neither corrupt nor a structure
+        mismatch, just laid out differently.  The caller re-applies
+        its own shardings to whatever comes back.
 
         Returns ``(state, step)`` or ``None`` when no step is
         restorable (caller starts fresh).  Corrupt steps are
@@ -158,7 +169,37 @@ class CheckpointManager:
             # ONE host must send EVERY host around the walk-back loop
             # together, or the lone failing host blocks forever in the
             # next broadcast while the others train
-            if self._agreed_ok(err is None):
+            ok = self._agreed_ok(err is None)
+            if not ok and alt_state_like is not None:
+                # alternate-layout retry (sharding-plan bridge).  The
+                # gate (`ok` + a host-identical argument) is the same
+                # decision on every host, so the collective
+                # choreography stays aligned; hosts whose primary
+                # restore locally succeeded retry too.
+                out, err2 = None, None
+                try:
+                    out = self.restore(alt_state_like, step)
+                except Exception as e:
+                    err2 = e
+                if self._agreed_ok(err2 is None):
+                    log.warning(
+                        "checkpoint step %d restored under the "
+                        "alternate sharding layout (primary layout "
+                        "failed: %s)", step, err)
+                    telemetry.default_registry().counter(
+                        "eksml_checkpoint_restores",
+                        "checkpoint restores completed").inc()
+                    telemetry.event("checkpoint_restore", step=step,
+                                    resharded=True)
+                    return out, step
+                # keep BOTH layouts' evidence for the verdict below;
+                # err2 can be None when only a remote host failed —
+                # never let that erase a real primary-layout error
+                if err2 is not None:
+                    err = err2 if err is None else RuntimeError(
+                        f"primary layout: {err}; alternate layout: "
+                        f"{err2}")
+            if ok:
                 telemetry.default_registry().counter(
                     "eksml_checkpoint_restores",
                     "checkpoint restores completed").inc()

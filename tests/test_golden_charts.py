@@ -50,6 +50,23 @@ def test_goldens_are_valid_k8s_documents(name):
         assert "kind" in d and "apiVersion" in d, (name, d)
 
 
+@pytest.mark.parametrize("name", ["maskrcnn__maskrcnn.yaml",
+                                  "maskrcnn-optimized__maskrcnn.yaml"])
+def test_golden_renders_sharding_knobs(name):
+    """Both charts' rendered train argv must carry the
+    TRAIN.SHARDING.* knobs (ISSUE 6) — the regen check that catches a
+    template/values edit dropping the sharding plan from either
+    chart."""
+    with open(os.path.join(REPO, render_charts.GOLDEN_DIR, name)) as f:
+        docs = [d for d in yaml.safe_load_all(f.read()) if d]
+    js = next(d for d in docs if d["kind"] == "JobSet")
+    tmpl = js["spec"]["replicatedJobs"][0]["template"]["spec"][
+        "template"]["spec"]
+    argv = tmpl["containers"][0]["command"]
+    assert "TRAIN.SHARDING.STRATEGY=replicated" in argv
+    assert "TRAIN.SHARDING.FSDP_AXIS_SIZE=0" in argv
+
+
 def test_golden_jobset_contract():
     """The bugs the string checks could not see: the rendered JobSet's
     numeric/structural fields are coherent end-to-end."""

@@ -252,6 +252,29 @@ def test_termination_grace_period_from_values(chart):
 
 @pytest.mark.parametrize("chart", ["charts/maskrcnn",
                                    "charts/maskrcnn-optimized"])
+def test_sharding_knobs_render_and_schema_matches_runtime(chart):
+    """The TRAIN.SHARDING.* knobs (ISSUE 6) render from both charts,
+    and the schema's strategy enum IS the runtime inventory — a
+    strategy added to parallel/sharding.py must land in the schema
+    (and vice versa) or this pins the drift."""
+    from eksml_tpu.parallel.sharding import STRATEGIES
+
+    tmpl = _read(f"{chart}/templates/maskrcnn.yaml")
+    assert ("TRAIN.SHARDING.STRATEGY="
+            "{{ .Values.maskrcnn.sharding_strategy }}") in tmpl
+    assert ("TRAIN.SHARDING.FSDP_AXIS_SIZE="
+            "{{ int .Values.maskrcnn.fsdp_axis_size }}") in tmpl
+    schema = json.loads(_read(f"{chart}/values.schema.json"))
+    props = schema["properties"]["maskrcnn"]["properties"]
+    assert tuple(props["sharding_strategy"]["enum"]) == STRATEGIES
+    assert props["fsdp_axis_size"]["minimum"] == 0
+    vals = yaml.safe_load(_read(f"{chart}/values.yaml"))["maskrcnn"]
+    # shipped default stays the parity layout
+    assert vals["sharding_strategy"] == "replicated"
+
+
+@pytest.mark.parametrize("chart", ["charts/maskrcnn",
+                                   "charts/maskrcnn-optimized"])
 def test_preempt_exit_code_maps_to_restart_not_fail(chart):
     tmpl = _read(f"{chart}/templates/maskrcnn.yaml")
     # Job level: the resumable exit code fails the Job with reason
