@@ -322,10 +322,16 @@ def main(argv=None):
             _run_with_remat(args, diag)
         else:
             run_ladder(args, diag)
+        # explicit machine-readable health: error rounds used to be
+        # recognizable only by value==0.0 + a ladder_abort blob, and
+        # every consumer (bench_gate, bank_round) special-cased zeros
+        diag.setdefault("status",
+                        "error" if diag.get("error") else "ok")
         _emit(diag)
     except Exception as e:  # noqa: BLE001 — diagnostic line must land
         import traceback
 
+        diag["status"] = "error"
         diag["error"] = f"{type(e).__name__}: {e}"
         diag["trace_tail"] = "".join(
             traceback.format_exception(type(e), e, e.__traceback__)
@@ -759,32 +765,14 @@ def run(args, diag: dict) -> None:
             return step(params, next_batch(),
                         jax.random.fold_in(rng, i))
     else:
-        def train_step(params, opt_state, batch, rng):
-            def loss_fn(p):
-                if plan is not None:
-                    p = plan.compute_params(p)  # fsdp just-in-time gather
-                losses = model.apply({"params": p}, batch, rng)
-                return losses["total_loss"], losses
+        # ONE step construction with profiling/predict.py (which
+        # AOT-prices this exact program) — see make_synthetic_train_step
+        from eksml_tpu.train import make_synthetic_train_step
 
-            grads, losses = jax.grad(loss_fn, has_aux=True)(params)
-            if plan is not None:
-                grads = plan.storage_grads(grads)  # reduce-scatter
-            # scope → "optimizer" in the profiling attribution
-            with jax.named_scope("optimizer"):
-                updates, new_opt = tx.update(grads, opt_state, params)
-                return (optax.apply_updates(params, updates), new_opt,
-                        losses["total_loss"])
-
-        if plan is not None:
-            repl = plan.replicated()
-            step = plan.jit(
-                train_step,
-                in_shardings=(param_sh, opt_sh,
-                              plan.batch_sharding(), repl),
-                out_shardings=(param_sh, opt_sh, repl),
-                donate_argnums=(0, 1))
-        else:
-            step = jax.jit(train_step, donate_argnums=(0, 1))
+        step = make_synthetic_train_step(
+            model, tx, plan,
+            param_sh if plan is not None else None,
+            opt_sh if plan is not None else None)
         lower_args = (params, opt_state, batch, rng)
 
         def run_step(i):
@@ -803,6 +791,7 @@ def run(args, diag: dict) -> None:
     # batches into _run_with_remat's retry compile (which runs within
     # ~0.5G of capacity by definition).
     flops_per_step = None
+    compiled = None
     try:
         try:
             compiled = step.lower(*lower_args).compile()
@@ -863,6 +852,39 @@ def run(args, diag: dict) -> None:
     diag["value"] = round(per_chip, 3)
     diag["prefetch"] = prefetch
     diag["param_dtype"] = cfg.TRAIN.PARAM_DTYPE
+    # predicted step time rides NEXT TO the measurement (ISSUE 7): a
+    # real hardware round self-calibrates the roofline model the
+    # hermetic gate (tools/perf_gate.py) runs on between windows.
+    # AFTER the timed loop on purpose — parsing a flagship-scale HLO
+    # text costs seconds and must never eat tunnel-window time before
+    # the measurement lands.  EKSML_BENCH_PREDICT=0 opts out.
+    # never on forward-only programs: the fields carry train-step
+    # semantics everywhere (calibration, bank_round), and a fwd-only
+    # prediction under the same names is a trap for every consumer
+    # that forgets the forward_only filter
+    if (compiled is not None and not fwd_only
+            and os.environ.get("EKSML_BENCH_PREDICT") != "0"):
+        try:
+            from eksml_tpu.profiling import predict as _predict
+
+            # cfg, not the flags: TRAIN.PRECISION / TPU.NUM_SLICES
+            # re-derive after --config overrides and slice detection
+            # (the sharding re-derivation rule above) — the wrong
+            # peak-flops row or link bandwidth would bank a badly
+            # scaled self-calibration point
+            pred = _predict.predict_for_compiled(
+                compiled.as_text(), device_kind=dev_kind,
+                mesh_shape=(dict(plan.mesh.shape)
+                            if plan is not None else {}),
+                precision=str(cfg.TRAIN.PRECISION),
+                num_slices=int(cfg.TPU.NUM_SLICES))
+            diag["predicted_step_time_ms"] = \
+                pred["predicted_step_time_ms"]
+            diag["predicted_sections_ms"] = pred["sections_ms"]
+            diag["predicted_target"] = pred["target"]
+        except Exception as e:  # noqa: BLE001 — prediction is advisory
+            print(f"bench: step-time prediction unavailable: {e}",
+                  file=sys.stderr)
     # a forward-only number must not be ratioed against the
     # train-throughput anchor — leave vs_baseline at 0 for the micro
     # rung (its value/mfu stand on their own, clearly labeled)

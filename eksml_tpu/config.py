@@ -202,12 +202,19 @@ RESILIENCE_DATA_DEFAULTS = dict(
 #   pod.  0 = legacy always-200.  Size it to cover the first-step XLA
 #   compile (minutes), not just steady-state steps — the charts'
 #   probe initialDelay rides the same value.
+# - PREDICTED_STEP_TIME: at the first step compile, AOT-lower the
+#   train step, price its HLO with the roofline model
+#   (eksml_tpu/profiling/predict.py) and publish the
+#   eksml_train_predicted_step_time_ms gauge — the measured-vs-
+#   predicted pair every scrape can alert on.  Costs one extra trace
+#   + an HLO text parse at fit start, never per step.
 TELEMETRY_DEFAULTS = dict(
     ENABLED=True,
     PORT=9090,
     AGGREGATE_HOSTS=True,
     FLIGHT_RECORDER_EVENTS=256,
     HEALTHZ_STALE_SEC=0.0,
+    PREDICTED_STEP_TIME=True,
 )
 
 # Sharding-plan knobs (eksml_tpu/parallel/sharding.py) — ONE source

@@ -8,6 +8,7 @@ from eksml_tpu.profiling.attribution import (FLOPS_PER_BYTE,  # noqa: F401
                                              HloAttribution,
                                              attribution_map,
                                              component_table,
+                                             is_collective_opcode,
                                              parse_hlo,
                                              resolve_component,
                                              write_attribution_artifact)
@@ -15,5 +16,5 @@ from eksml_tpu.profiling.attribution import (FLOPS_PER_BYTE,  # noqa: F401
 __all__ = [
     "HloAttribution", "attribution_map", "component_table",
     "parse_hlo", "resolve_component", "write_attribution_artifact",
-    "FLOPS_PER_BYTE",
+    "FLOPS_PER_BYTE", "is_collective_opcode",
 ]

@@ -50,11 +50,16 @@ DTYPE_BYTES = {
 FLOPS_PER_BYTE = 240.0
 
 # Opcodes that are pure structure — no data touched at runtime (or the
-# cost is counted inside the called computation instead).
+# cost is counted inside the called computation instead).  The *-done
+# halves of async collectives are here too: the traffic is counted on
+# the matching *-start, and a done carrying the full output shape
+# would double every async collective's bytes.
 _CONTAINER_OPS = frozenset((
     "fusion", "call", "while", "conditional", "tuple",
     "get-tuple-element", "parameter", "constant", "bitcast",
     "after-all", "opt-barrier",
+    "all-reduce-done", "all-gather-done", "collective-permute-done",
+    "reduce-scatter-done", "all-to-all-done",
 ))
 
 # Collective opcodes → the "allreduce" component regardless of scope
@@ -62,8 +67,15 @@ _CONTAINER_OPS = frozenset((
 _COLLECTIVE_OPS = frozenset((
     "all-reduce", "reduce-scatter", "all-gather", "collective-permute",
     "all-to-all", "all-reduce-start", "all-gather-start",
-    "collective-permute-start",
+    "collective-permute-start", "reduce-scatter-start",
+    "all-to-all-start",
 ))
+
+
+def is_collective_opcode(opcode: str) -> bool:
+    """True for inter-chip collective opcodes — the predictor prices
+    these against link bandwidth (ICI), not HBM (predict.py)."""
+    return opcode in _COLLECTIVE_OPS
 
 # op_name scope → component.  First match wins; searched on the
 # lowercased path.  ``bwd_split=True`` components get a "-bwd" suffix

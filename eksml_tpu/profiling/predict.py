@@ -1,0 +1,617 @@
+"""AOT roofline prediction: compiled train-step HLO → step time in ms.
+
+Five bench rounds in a row banked 0.0 img/s — tunnel and backend-init
+failures, never the model — so the repo's perf evidence only moves
+when a rare hardware window opens (ROADMAP open item 3).  This module
+is the hermetic half of the fix: lower the REAL train step for a named
+TPU target on CPU (``JAX_PLATFORMS=cpu`` — XLA emits the same program
+structure it would ship to the chip), feed the optimized HLO through
+the existing attribution parser (attribution.py), and price every
+instruction against the target chip's roofline:
+
+- compute ops:    ``t = max(flops / peak_flops, bytes / hbm_bw)``
+- collectives:    ``t = bytes × ring_factor(k) / ici_bw`` with ``k``
+  the participating-device count from the sharding plan (PR 6) — an
+  all-reduce moves ``2(k-1)/k`` of its payload per link, a
+  reduce-scatter/all-gather ``(k-1)/k``.
+
+Summing per resolved component (SCOPE_RULES) yields a predicted step
+time that is *component-attributed*: a regression names the component
+that moved ("backbone-bwd predicted +34%"), not a bare number.
+
+The absolute number is a model, not a measurement — so it ships with
+its own honesty check: :func:`calibrate` fits one scale factor per
+rung against the banked hardware artifacts (``artifacts/roi_ab_r5.json``,
+``bench_rung_1344_b4.json``) and reports how far the per-rung factors
+spread from their common fit.  If the model scaled geometry correctly
+the factors agree; the spread IS the model error, and it is printed in
+every gate run (tools/perf_gate.py) and pinned in
+tests/test_perf_gate.py.
+
+Consumers: ``tools/perf_gate.py`` (the CI gate), ``bench.py`` (emits
+predicted next to measured so real rounds self-calibrate), and
+``Trainer.fit`` (the ``eksml_train_predicted_step_time_ms`` gauge).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from eksml_tpu.profiling.attribution import (HloAttribution,
+                                             is_collective_opcode)
+
+log = logging.getLogger(__name__)
+
+#: the gauge Trainer.fit publishes at the first step compile — ONE
+#: definition for trainer and tests
+PREDICTED_GAUGE = "eksml_train_predicted_step_time_ms"
+
+# Chip spec table for the roofline terms.  Peak flops are the vendor
+# bf16 systolic numbers (bench.py PEAK_FLOPS uses the same); f32 runs
+# the MXU at half rate.  Link bandwidths are per-chip aggregate ICI
+# and the per-host DCN NIC share — the model only needs them to the
+# ~2× level (the calibration scale factor absorbs constant error; the
+# per-rung spread it cannot absorb is reported as model error).
+CHIP_SPECS: Dict[str, Dict[str, Any]] = {
+    "v5e": {
+        "peak_flops": {"bfloat16": 197e12, "float32": 98.5e12},
+        "hbm_bytes_per_sec": 819e9,
+        "ici_bytes_per_sec": 200e9,   # 1600 Gbps aggregate
+        "dcn_bytes_per_sec": 25e9,
+    },
+    "v4": {
+        "peak_flops": {"bfloat16": 275e12, "float32": 137.5e12},
+        "hbm_bytes_per_sec": 1228e9,
+        "ici_bytes_per_sec": 300e9,   # 2400 Gbps
+        "dcn_bytes_per_sec": 25e9,
+    },
+    "v6e": {
+        "peak_flops": {"bfloat16": 918e12, "float32": 459e12},
+        "hbm_bytes_per_sec": 1640e9,
+        "ici_bytes_per_sec": 448e9,   # 3584 Gbps
+        "dcn_bytes_per_sec": 25e9,
+    },
+}
+
+# jax device_kind → spec name (the strings bench.py's PEAK_FLOPS keys
+# on; unknown kinds — "cpu" included — resolve to None and callers
+# fall back to the configured target)
+DEVICE_KIND_TO_TARGET = {
+    "TPU v5 lite": "v5e",
+    "TPU v5e": "v5e",
+    "TPU v4": "v4",
+    "TPU v6 lite": "v6e",
+    "TPU v6e": "v6e",
+}
+
+DEFAULT_TARGET = "v5e"
+
+
+def load_json(path: str) -> Optional[Dict]:
+    """Swallow-errors JSON loader — ONE definition for the calibration
+    pairing here and tools/perf_gate.py (a missing or truncated
+    artifact reads as absent, never a crash)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def chip_spec(target: str) -> Dict[str, Any]:
+    if target not in CHIP_SPECS:
+        raise ValueError(
+            f"unknown TPU target {target!r}; known: "
+            f"{sorted(CHIP_SPECS)}")
+    return CHIP_SPECS[target]
+
+
+def target_for_device_kind(kind: Optional[str]) -> Optional[str]:
+    return DEVICE_KIND_TO_TARGET.get(kind or "")
+
+
+def _ring_factor(opcode: str, k: int) -> float:
+    """Fraction of the payload each link carries in a ring schedule of
+    ``k`` participants.  k=1 → 0 (no traffic)."""
+    if k <= 1:
+        return 0.0
+    if opcode.startswith("all-reduce"):
+        return 2.0 * (k - 1) / k
+    if opcode.startswith("collective-permute"):
+        return 1.0
+    # all-gather / reduce-scatter / all-to-all
+    return float(k - 1) / k
+
+
+def comm_sizes_for_mesh(mesh_shape: Dict[str, int]) -> Dict[str, int]:
+    """Sharding-plan mesh → per-collective participant counts.
+
+    all-gather / reduce-scatter are the fsdp param/grad layout moves
+    (they ride the ``fsdp`` axis); all-reduce is the gradient sum over
+    all replicas (``data × fsdp``).  The batch axes are the two that
+    carry replicas (sharding.py batch_spec)."""
+    fsdp = int(mesh_shape.get("fsdp", 1))
+    data = int(mesh_shape.get("data", 1))
+    return {
+        "all-gather": fsdp,
+        "reduce-scatter": fsdp,
+        "all-reduce": data * fsdp,
+        "collective-permute": 2,
+        "all-to-all": max(data * fsdp, 1),
+    }
+
+
+def _comm_k(comm_sizes: Dict[str, int], opcode: str) -> int:
+    for prefix, k in comm_sizes.items():
+        if opcode.startswith(prefix):
+            return int(k)
+    return 1
+
+
+def section_of(component: str) -> str:
+    """Component → fwd/bwd/comms/optimizer bucket (the headline
+    split).  Unresolved "other" cost rides fwd — it is almost always
+    input plumbing XLA stripped metadata from."""
+    if component == "allreduce":
+        return "comms"
+    if component == "optimizer":
+        return "optimizer"
+    if component.endswith("-bwd"):
+        return "bwd"
+    return "fwd"
+
+
+def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
+                     precision: str = "bfloat16",
+                     comm_sizes: Optional[Dict[str, int]] = None,
+                     slice_devices: Optional[int] = None
+                     ) -> Dict[str, Any]:
+    """Compiled-HLO text → predicted step time for ``target``.
+
+    Per-instruction roofline summed per attributed component; see the
+    module docstring for the cost terms.  ``comm_sizes`` prices the
+    collectives (:func:`comm_sizes_for_mesh`); absent, every
+    collective is assumed 2-way — a single-device program has no
+    collectives, so the default only matters when a caller lowered a
+    sharded program and forgot the sizes.  A collective whose ring is
+    wider than ``slice_devices`` crosses a slice boundary and is
+    priced against the DCN NIC instead of ICI (None = single slice,
+    everything rides ICI — all current lowerings)."""
+    spec = chip_spec(target)
+    peak = float(spec["peak_flops"].get(precision)
+                 or spec["peak_flops"]["bfloat16"])
+    hbm = float(spec["hbm_bytes_per_sec"])
+    ici = float(spec["ici_bytes_per_sec"])
+    dcn = float(spec["dcn_bytes_per_sec"])
+    if comm_sizes is None:
+        comm_sizes = {"all-": 2, "reduce-scatter": 2,
+                      "collective-permute": 2}
+
+    attr = HloAttribution(hlo_text)
+    comp_sec: Dict[str, float] = {}
+    comp_costs: Dict[str, Dict[str, float]] = {}
+    totals = {"flops": 0.0, "hbm_bytes": 0.0, "collective_bytes": 0.0}
+    for instrs in attr.comps.values():
+        for ins in instrs:
+            if ins.cost <= 0:
+                continue
+            comp = attr.instr_component.get(ins.name) or "other"
+            row = comp_costs.setdefault(
+                comp, {"flops": 0.0, "bytes": 0.0,
+                       "collective_bytes": 0.0})
+            if is_collective_opcode(ins.opcode):
+                k = _comm_k(comm_sizes, ins.opcode)
+                # the slowest link bounds the ring: DCN once it spans
+                # more devices than one slice holds
+                bw = (ici if (slice_devices is None
+                              or k <= slice_devices) else dcn)
+                t = ins.bytes * _ring_factor(ins.opcode, k) / bw
+                totals["collective_bytes"] += ins.bytes
+                row["collective_bytes"] += ins.bytes
+            else:
+                t = max(ins.flops / peak, ins.bytes / hbm)
+                totals["flops"] += ins.flops
+                totals["hbm_bytes"] += ins.bytes
+                row["flops"] += ins.flops
+                row["bytes"] += ins.bytes
+            comp_sec[comp] = comp_sec.get(comp, 0.0) + t
+
+    components_ms = {c: round(t * 1e3, 4) for c, t in
+                     sorted(comp_sec.items(), key=lambda kv: -kv[1])}
+    sections_ms: Dict[str, float] = {"fwd": 0.0, "bwd": 0.0,
+                                     "comms": 0.0, "optimizer": 0.0}
+    for comp, t in comp_sec.items():
+        sections_ms[section_of(comp)] += t * 1e3
+    total_ms = sum(comp_sec.values()) * 1e3
+    return {
+        "target": target,
+        "precision": precision,
+        "predicted_step_time_ms": round(total_ms, 4),
+        "sections_ms": {k: round(v, 4) for k, v in
+                        sections_ms.items()},
+        "components_ms": components_ms,
+        "component_costs": comp_costs,
+        "totals": {k: round(v, 1) for k, v in totals.items()},
+        "comm_sizes": dict(comm_sizes),
+    }
+
+
+def predict_for_compiled(hlo_text: str,
+                         device_kind: Optional[str] = None,
+                         mesh_shape: Optional[Dict[str, int]] = None,
+                         precision: str = "bfloat16",
+                         num_slices: int = 1) -> Dict[str, Any]:
+    """ONE pricing entry point for an already-compiled program: derive
+    the target from the device kind, the collective participant counts
+    from the mesh, and the per-slice device count from ``num_slices``
+    (collectives spanning slices price against DCN).  The trainer's
+    gauge and bench's self-calibration point MUST price through this
+    one path — two hand-maintained invocation blocks would silently
+    diverge on exactly the pricing inputs calibration depends on."""
+    target = (target_for_device_kind(device_kind) or DEFAULT_TARGET)
+    mesh_shape = dict(mesh_shape or {})
+    slice_devices = None
+    if num_slices and int(num_slices) > 1:
+        total = 1
+        for v in mesh_shape.values():
+            total *= int(v)
+        slice_devices = max(1, total // int(num_slices))
+    return predict_from_hlo(
+        hlo_text, target=target, precision=precision,
+        comm_sizes=comm_sizes_for_mesh(mesh_shape),
+        slice_devices=slice_devices)
+
+
+# ---- AOT lowering of the real train step (CPU, no hardware) ---------
+
+
+def lower_train_step(cfg, batch_size: int, image_size=None,
+                     pad_hw: Optional[Tuple[int, int]] = None,
+                     strategy: str = "replicated",
+                     fsdp_axis: int = 2
+                     ) -> Tuple[str, Dict[str, Any]]:
+    """AOT-lower + compile the real train step; → (hlo_text, meta).
+
+    The same program construction bench.py measures: model from cfg,
+    synthetic batch at the padded canvas, jitted init, optimizer, and
+    — under ``fsdp`` — the sharding plan's just-in-time gather /
+    storage-grad constraints over a ``(1, fsdp_axis, 1)`` mesh of
+    host-platform devices.  Only compiles; never executes a step, so
+    it runs on any backend (the gate runs it under
+    ``JAX_PLATFORMS=cpu``).
+
+    ``meta`` carries the comm sizes for :func:`predict_from_hlo` plus
+    the geometry, so a banked prediction is self-describing.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from eksml_tpu.data.loader import make_synthetic_batch
+    from eksml_tpu.models import MaskRCNN
+    from eksml_tpu.train import (cast_params_for_storage,
+                                 make_optimizer,
+                                 make_synthetic_train_step)
+
+    shape = tuple(pad_hw) if pad_hw else image_size
+    model = MaskRCNN.from_config(cfg)
+    rng = jax.random.PRNGKey(0)
+    tx, _ = make_optimizer(cfg)
+
+    plan = None
+    mesh_shape: Dict[str, int] = {}
+    if strategy == "fsdp":
+        from eksml_tpu.parallel import build_mesh
+        from eksml_tpu.parallel.sharding import ShardingPlan
+
+        devices = jax.devices()
+        if len(devices) < fsdp_axis:
+            raise ValueError(
+                f"fsdp lowering needs {fsdp_axis} devices, have "
+                f"{len(devices)} — set XLA_FLAGS=--xla_force_host_"
+                f"platform_device_count={fsdp_axis} before jax loads "
+                "(tools/perf_gate.py does)")
+        mesh = build_mesh((1, fsdp_axis, 1), ("data", "fsdp", "model"),
+                          devices[:fsdp_axis], num_slices=1)
+        plan = ShardingPlan("fsdp", mesh)
+        mesh_shape = dict(mesh.shape)
+    elif strategy != "replicated":
+        raise ValueError(
+            f"lower_train_step supports 'replicated' and 'fsdp', got "
+            f"{strategy!r}")
+
+    # per-chip batch semantics under a plan (the trainer/bench
+    # contract); the replicated path is the historical single-device
+    # program whose numbers the banked r5 artifacts measured
+    global_bs = batch_size * (fsdp_axis if plan is not None else 1)
+    batch = make_synthetic_batch(cfg, batch_size=global_bs,
+                                 image_size=shape)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+
+    def init_fn(r, b):
+        return model.init(r, b, r)["params"]
+
+    if plan is not None:
+        batch = jax.device_put(batch, plan.batch_sharding())
+        params, param_sh = plan.init_sharded(init_fn, rng, batch)
+    else:
+        params = jax.jit(init_fn)(rng, batch)
+    params = cast_params_for_storage(
+        params, getattr(cfg.TRAIN, "PARAM_DTYPE", "float32"))
+    if plan is not None:
+        opt_state, opt_sh = plan.init_sharded(tx.init, params)
+    else:
+        opt_state = tx.init(params)
+
+    # ONE step construction with bench.py — the program priced here
+    # must be the program the hardware measures
+    step = make_synthetic_train_step(
+        model, tx, plan,
+        param_sh if plan is not None else None,
+        opt_sh if plan is not None else None)
+    hlo = step.lower(params, opt_state, batch, rng).compile().as_text()
+
+    meta = {
+        "strategy": strategy,
+        "batch_size": batch_size,
+        "image_size": (list(pad_hw) if pad_hw else image_size),
+        "precision": str(cfg.TRAIN.PRECISION),
+        "param_dtype": str(getattr(cfg.TRAIN, "PARAM_DTYPE",
+                                   "float32")),
+        "remat": bool(getattr(cfg.TRAIN, "REMAT", False)),
+        "comm_sizes": comm_sizes_for_mesh(mesh_shape),
+        "mesh_shape": mesh_shape,
+    }
+    return hlo, meta
+
+
+# ---- prediction comparison (the gate's FAIL logic) ------------------
+
+
+def compare_predictions(fresh: Dict[str, Any], base: Dict[str, Any],
+                        max_regress_pct: float = 10.0,
+                        min_share_pct: float = 5.0
+                        ) -> Tuple[bool, Dict[str, Any]]:
+    """(ok, verdict) for one fresh-vs-banked prediction pair.
+
+    FAILs on a total predicted-step-time regression beyond
+    ``max_regress_pct``, or on any component holding ≥``min_share_pct``
+    of the baseline regressing beyond 2× the bound (a big component
+    regression must not hide behind an unrelated improvement).  The
+    verdict always carries the per-component diff — the gate's message
+    names the worst mover, never just the bare total."""
+    ft = float(fresh["predicted_step_time_ms"])
+    bt = float(base["predicted_step_time_ms"])
+    verdict: Dict[str, Any] = {
+        "fresh_ms": round(ft, 3), "baseline_ms": round(bt, 3),
+        "max_regress_pct": max_regress_pct,
+    }
+    if bt <= 0:
+        verdict["error"] = "baseline prediction is <= 0 ms — rebank it"
+        return False, verdict
+    total_pct = (ft / bt - 1.0) * 100.0
+    verdict["total_regress_pct"] = round(total_pct, 2)
+
+    fc = fresh.get("components_ms", {})
+    bc = base.get("components_ms", {})
+    diffs = []
+    for comp in sorted(set(fc) | set(bc)):
+        b = float(bc.get(comp, 0.0))
+        f = float(fc.get(comp, 0.0))
+        share = 100.0 * max(b, f) / bt
+        if share < 1.0:
+            continue
+        pct = ((f / b - 1.0) * 100.0) if b > 0 else None
+        diffs.append({"component": comp,
+                      "baseline_ms": round(b, 3),
+                      "fresh_ms": round(f, 3),
+                      "share_pct": round(share, 1),
+                      "regress_pct": (round(pct, 1)
+                                      if pct is not None else "new")})
+    diffs.sort(key=lambda d: -(d["fresh_ms"] - d["baseline_ms"]))
+    verdict["components"] = diffs
+
+    def _worst() -> str:
+        for d in diffs:
+            if d["fresh_ms"] > d["baseline_ms"]:
+                delta = d["regress_pct"]
+                delta = (f"+{delta}%" if isinstance(delta, float)
+                         else "new")
+                return (f"{d['component']} predicted {delta} "
+                        f"({d['baseline_ms']}ms -> {d['fresh_ms']}ms)")
+        return "no single component regressed (uniform drift)"
+
+    if total_pct > max_regress_pct:
+        verdict["error"] = (
+            f"predicted step time regressed {total_pct:+.1f}% "
+            f"({bt:.2f}ms -> {ft:.2f}ms); worst component: {_worst()}")
+        return False, verdict
+    for d in diffs:
+        b, f = d["baseline_ms"], d["fresh_ms"]
+        if b <= 0:
+            # brand-new component: no ratio exists, so the 2x-bound
+            # check can't see it — a big one hiding behind an
+            # unrelated win is exactly the masked class
+            if f > 0 and d["share_pct"] >= min_share_pct:
+                verdict["error"] = (
+                    f"new component {d['component']} predicted "
+                    f"{f}ms ({d['share_pct']}% of the step) while "
+                    f"the total moved only {total_pct:+.1f}% — a "
+                    "masked regression")
+                return False, verdict
+            continue
+        # share_pct is max(b, f)/baseline-total: a component that
+        # EXPLODED from a tiny baseline holds its fresh share, and
+        # judging by the baseline share alone would wave it through
+        if (d["share_pct"] >= min_share_pct
+                and (f / b - 1.0) * 100.0 > 2.0 * max_regress_pct):
+            verdict["error"] = (
+                f"component {d['component']} predicted "
+                f"{(f / b - 1) * 100:+.1f}% ({b}ms -> {f}ms, "
+                f"{d['share_pct']}% of the step) while the total "
+                f"moved only {total_pct:+.1f}% — a masked regression")
+            return False, verdict
+    return True, verdict
+
+
+# ---- calibration against banked hardware measurements ---------------
+
+#: (artifact file, run name inside it or None for a flat record,
+#:  prediction-bank rung key) — the committed r5 evidence the model is
+#: calibrated against.  Measurements are full-width hardware runs; the
+#: committed predictions are smoke-width lowerings, so the absolute
+#: scale factor is large and meaningless alone — its CONSISTENCY
+#: across rungs is the honesty metric (see calibrate()).
+R5_CALIBRATION_SOURCES = (
+    ("roi_ab_r5.json", "roi_ab_bwd_pallas_512", "512_b4"),
+    ("roi_ab_r5.json", "roi_ab_bwd_pallas_1344", "1344_b4"),
+    ("bench_rung_1344_b4.json", None, "1344_b4"),
+)
+
+
+def calibration_points(artifacts_dir: str,
+                       strategy: str = "replicated",
+                       precision: str = "bfloat16") -> List[Dict]:
+    """Pair banked hardware measurements with banked predictions.
+
+    Two pairing routes:
+    - the pinned r5 sources above, matched to
+      ``perf_pred_<rung>_<strategy>_<precision>.json``;
+    - any ``bench_rung_*.json`` that already CARRIES a
+      ``predicted_step_time_ms`` (bench.py emits predicted next to
+      measured since this gate landed) — fresh hardware rounds
+      self-calibrate with no pinned table.
+    """
+    points: List[Dict] = []
+    for fname, run_name, rung in R5_CALIBRATION_SOURCES:
+        rec = load_json(os.path.join(artifacts_dir, fname))
+        if rec is None:
+            continue
+        if run_name is not None:
+            rec = next((r for r in rec.get("runs", ())
+                        if r.get("run") == run_name), None)
+            if rec is None:
+                continue
+        elif rec.get("predicted_step_time_ms"):
+            # the flat artifact carries its own (measured-width)
+            # prediction — the glob route below pairs it; pairing it
+            # AGAIN here against the banked smoke-width prediction
+            # would count the same measurement twice and skew the fit
+            continue
+        measured = rec.get("step_time_ms")
+        if not measured or measured <= 0 or rec.get("error"):
+            continue
+        pred_path = os.path.join(
+            artifacts_dir, f"perf_pred_{rung}_{strategy}_"
+                           f"{precision}.json")
+        pred = load_json(pred_path)
+        if not pred or not pred.get("predicted_step_time_ms"):
+            continue
+        src = f"{fname}:{run_name or 'flat'}"
+        points.append({
+            "rung": rung,
+            "measured_ms": float(measured),
+            "measured_source": src,
+            "predicted_ms": float(pred["predicted_step_time_ms"]),
+            "predicted_source": os.path.basename(pred_path),
+            # full-width measurement vs SMOKE-width banked prediction
+            "fit_group": "smoke",
+        })
+    import glob
+
+    for path in sorted(glob.glob(os.path.join(artifacts_dir,
+                                              "bench_rung_*.json"))):
+        rec = load_json(path)
+        if not rec:
+            continue
+        measured = rec.get("step_time_ms")
+        predicted = rec.get("predicted_step_time_ms")
+        # forward_only mirrors bank_round.py: the 3-step micro rung is
+        # dispatch-overhead-dominated, and its scale factor would
+        # systematically skew the train-step fit
+        if (measured and measured > 0 and predicted and predicted > 0
+                and rec.get("status") != "error"
+                and not rec.get("forward_only")):
+            points.append({
+                "rung": rec.get("operating_point",
+                                os.path.basename(path)),
+                "measured_ms": float(measured),
+                "measured_source": os.path.basename(path),
+                "predicted_ms": float(predicted),
+                "predicted_source": "embedded",
+                # bench.py priced the measured-width compiled HLO
+                "fit_group": "measured",
+            })
+    return points
+
+
+def calibrate(points: List[Dict]) -> Dict[str, Any]:
+    """Fit one scale factor per rung; report how far they spread.
+
+    ``scale_i = measured_i / predicted_i``; the common fit is the
+    geometric mean WITHIN each ``fit_group`` — smoke-width banked
+    predictions carry a channel-width scale that measured-width
+    embedded predictions do not, and pooling them would report that
+    known width gap as model error.  ``model_error_pct`` = the largest
+    per-rung deviation from its own group's fit — 0 means the model
+    ranks and scales geometries exactly as the hardware does, and any
+    honest use of the predictions (gating RATIOS, never absolutes) is
+    safe within that error.  ``scale`` is the smoke-bank group's fit
+    (the one tools/perf_gate.py's banked baselines live at); every
+    group's fit is in ``scales``."""
+    import math
+
+    out: Dict[str, Any] = {"n_points": len(points), "points": []}
+    usable = [p for p in points
+              if p["predicted_ms"] > 0 and p["measured_ms"] > 0]
+    if not usable:
+        out["note"] = ("no calibration points — bank predictions for "
+                       "the measured rungs (tools/perf_gate.py "
+                       "--update-baseline) or land a hardware round")
+        out["scale"] = None
+        out["model_error_pct"] = None
+        return out
+    groups: Dict[str, List[Dict]] = {}
+    for p in usable:
+        groups.setdefault(p.get("fit_group", "smoke"), []).append(p)
+    out["scales"] = {}
+    errs = []
+    for gname in sorted(groups):
+        gpts = groups[gname]
+        scales = [p["measured_ms"] / p["predicted_ms"] for p in gpts]
+        common = math.exp(sum(math.log(s) for s in scales)
+                          / len(scales))
+        out["scales"][gname] = round(common, 2)
+        for p, s in zip(gpts, scales):
+            err = (s / common - 1.0) * 100.0
+            errs.append(abs(err))
+            out["points"].append({
+                **{k: p[k] for k in ("rung", "measured_ms",
+                                     "predicted_ms",
+                                     "measured_source")},
+                "fit_group": gname,
+                "scale": round(s, 2),
+                "deviation_pct": round(err, 2),
+            })
+    out["scale"] = out["scales"].get(
+        "smoke", next(iter(out["scales"].values())))
+    out["model_error_pct"] = round(max(errs), 2)
+    if len(usable) < 2:
+        out["note"] = ("single calibration point: scale is exact by "
+                       "construction; model error needs >=2 rungs")
+    return out
+
+
+def publish_predicted_gauge(pred: Dict[str, Any]) -> None:
+    """Set the ``eksml_train_predicted_step_time_ms`` gauge from a
+    prediction — ONE definition of name + help for trainer and tests."""
+    from eksml_tpu import telemetry
+
+    telemetry.default_registry().gauge(
+        PREDICTED_GAUGE,
+        "roofline-predicted step time for this run's compiled train "
+        "step on the target chip (eksml_tpu/profiling/predict.py)"
+    ).set(float(pred["predicted_step_time_ms"]))

@@ -178,6 +178,45 @@ def cast_params_for_storage(params, param_dtype: str):
                    if x.dtype == jnp.float32 else x), params)
 
 
+def make_synthetic_train_step(model, tx, plan=None, param_sh=None,
+                              opt_sh=None):
+    """The synthetic-batch train step: grad of the model's total loss,
+    the plan's just-in-time gather / storage-grad constraints when one
+    is active, optimizer update under the ``optimizer`` named scope.
+
+    ONE construction shared by bench.py (which measures it) and
+    profiling/predict.py (which AOT-prices it), so the predicted
+    program can never silently diverge from the measured one — the
+    calibration fit's honesty depends on them being the same program.
+    ``param_sh``/``opt_sh`` are the plan's state shardings
+    (``init_sharded``); ignored without a plan."""
+
+    def train_step(params, opt_state, batch, rng):
+        def loss_fn(p):
+            if plan is not None:
+                p = plan.compute_params(p)  # fsdp just-in-time gather
+            losses = model.apply({"params": p}, batch, rng)
+            return losses["total_loss"], losses
+
+        grads, losses = jax.grad(loss_fn, has_aux=True)(params)
+        if plan is not None:
+            grads = plan.storage_grads(grads)  # reduce-scatter
+        # scope → "optimizer" in the profiling attribution
+        with jax.named_scope("optimizer"):
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_opt,
+                    losses["total_loss"])
+
+    if plan is not None:
+        repl = plan.replicated()
+        return plan.jit(train_step,
+                        in_shardings=(param_sh, opt_sh,
+                                      plan.batch_sharding(), repl),
+                        out_shardings=(param_sh, opt_sh, repl),
+                        donate_argnums=(0, 1))
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
 def _preregister_core_metrics(registry) -> None:
     """Create the always-present series so the FIRST scrape of a
     healthy run already shows every resilience/data counter at 0 —
@@ -722,10 +761,15 @@ class Trainer:
                     if step >= total_steps:
                         break
                 first_call = step_fn is None
-                if first_call:
-                    step_fn = self.compiled_step()
                 if watchdog:
+                    # beat BEFORE the first-call AOT compile below: a
+                    # hung multi-minute XLA compile must be stack-
+                    # dumped as a stalled train_step, not pinned on
+                    # globalize_batch (the previous beat)
                     watchdog.beat("train_step", step + 1)
+                if first_call:
+                    step_fn = self._step_fn_with_prediction(
+                        self.compiled_step(), state, device_batch)
                 # host-side dispatch of the compiled step (the device
                 # executes async; blocking shows up in data_wait /
                 # host_metrics instead — the Dapper-style host timeline)
@@ -998,6 +1042,80 @@ class Trainer:
                               "during shutdown failed (keeping the "
                               "original exception)")
         return state
+
+    @staticmethod
+    def _batch_shape_key(batch) -> Tuple:
+        """Hashable (name, shape, dtype) signature of a device batch —
+        the AOT-executable dispatch guard below."""
+        return tuple(sorted(
+            (k, tuple(np.shape(v)), str(getattr(v, "dtype", "?")))
+            for k, v in batch.items()))
+
+    def _step_fn_with_prediction(self, jit_step, state, batch):
+        """AOT-compile the first batch shape and publish the
+        ``eksml_train_predicted_step_time_ms`` gauge from its HLO
+        (roofline model, profiling/predict.py) — the hermetic
+        prediction next to every measured step-time scrape, published
+        at fit start as the compile happens anyway.
+
+        Returns the step callable: the AOT executable for batches
+        matching the first shape (so the compile is paid ONCE — the
+        jit wrapper never compiles this shape), falling back to the
+        jit wrapper for any other bucket canvas exactly as before.
+        Knob-gated (``TELEMETRY.PREDICTED_STEP_TIME``) and best-effort:
+        a failed compile returns the untouched jit wrapper; a failed
+        pricing still dispatches the already-paid AOT executable."""
+        if not (self._telemetry["ENABLED"]
+                and self._telemetry.get("PREDICTED_STEP_TIME")):
+            return jit_step
+        first_key = self._batch_shape_key(batch)
+        cached = getattr(self, "_aot_step_cache", None)
+        if cached is not None and cached[0] == first_key:
+            # a second fit on this trainer (the two-sequential-fits
+            # pattern): the AOT executable is already compiled and the
+            # gauge already published — lowering again would pay the
+            # full XLA compile a second time
+            compiled = cached[1]
+        else:
+            try:
+                compiled = jit_step.lower(state, batch).compile()
+            except Exception:  # noqa: BLE001 — observability only
+                log.warning("predicted-step-time gauge unavailable",
+                            exc_info=True)
+                return jit_step
+            self._aot_step_cache = (first_key, compiled)
+            try:
+                from eksml_tpu.profiling import predict as predict_mod
+
+                kind = getattr(self.mesh.devices.flat[0],
+                               "device_kind", "")
+                # ONE pricing path with bench.py's self-calibration
+                # point — see predict_for_compiled
+                pred = predict_mod.predict_for_compiled(
+                    compiled.as_text(), device_kind=kind,
+                    mesh_shape=dict(self.mesh.shape),
+                    precision=str(self.cfg.TRAIN.PRECISION),
+                    num_slices=int(self.cfg.TPU.NUM_SLICES))
+                predict_mod.publish_predicted_gauge(pred)
+                s = pred["sections_ms"]
+                log.info(
+                    "predicted step time (%s roofline): %.2f ms "
+                    "(fwd %.2f / bwd %.2f / comms %.2f / "
+                    "optimizer %.2f)",
+                    pred["target"], pred["predicted_step_time_ms"],
+                    s["fwd"], s["bwd"], s["comms"], s["optimizer"])
+            except Exception:  # noqa: BLE001 — observability only
+                # the AOT compile is already paid: keep dispatching
+                # it even when the pricing half fell over
+                log.warning("predicted-step-time gauge unavailable",
+                            exc_info=True)
+
+        def dispatch(s, b):
+            if self._batch_shape_key(b) == first_key:
+                return compiled(s, b)
+            return jit_step(s, b)  # another bucket: jit as before
+
+        return dispatch
 
     def _start_capture(self, req: Dict, step: int) -> Dict:
         """Begin a bounded profiler capture: ``jax.profiler`` trace

@@ -73,7 +73,10 @@ def collect(round_num: int, since: str | None = None) -> dict:
     for p in (os.path.join(REPO, "BENCH_LOCAL.json"),
               os.path.join(art, "bench_last_good.json")):
         d = _load(p)
-        if (d and (d.get("value") or 0) > 0 and is_hardware(d)
+        # status is the explicit health mark bench.py stamps (ISSUE
+        # 7); the value>0 check stays for pre-status artifacts
+        if (d and d.get("status") != "error"
+                and (d.get("value") or 0) > 0 and is_hardware(d)
                 and not d.get("forward_only") and _fresh(d, since)):
             out["bench"] = d["value"]
             out["mfu"] = d.get("mfu")
@@ -84,8 +87,10 @@ def collect(round_num: int, since: str | None = None) -> dict:
     for p in sorted(glob.glob(os.path.join(art, "bench_rung_*.json"))):
         d = _load(p)
         # value>0 mirrors the banking gate (ADVICE r4): a zero rung
-        # artifact must not be reported as a banked ladder rung
-        if (d and (d.get("value") or 0) > 0 and is_hardware(d)
+        # artifact must not be reported as a banked ladder rung;
+        # status mirrors the explicit error mark
+        if (d and d.get("status") != "error"
+                and (d.get("value") or 0) > 0 and is_hardware(d)
                 and _fresh(d, since)):
             out["rungs"][d.get("operating_point",
                                os.path.basename(p))] = {

@@ -18,6 +18,11 @@ tool is the missing regression gate:
   (or when throughput ``value`` drops by more than the same bound,
   when both carry it).  A fresh error line fails too — a gate that
   passes on "the bench crashed" is not a gate.
+- ``--predicted``: when the FRESHEST banked round is itself an error
+  round (``status: "error"`` — the r01–r05 tunnel reality), delegate
+  to the hermetic predicted-step-time bank (``tools/perf_gate.py``)
+  instead of skipping silently; the verdict's ``evidence_source``
+  names which trajectory gated the change.
 
 Usage::
 
@@ -69,10 +74,13 @@ def usable_measurement(line: Optional[Dict]) -> Optional[Dict]:
         return None
 
     def _ok(d: Dict) -> bool:
-        # both compared numbers must be real: a step_time_ms of 0
-        # would divide the gate by zero as a baseline and trivially
+        # an explicit error mark wins over whatever numbers rode
+        # along (bench.py stamps status on every line since ISSUE 7);
+        # both compared numbers must also be real: a step_time_ms of
+        # 0 would divide the gate by zero as a baseline and trivially
         # PASS as a fresh line — "the bench crashed" must fail
-        return ((d.get("value", 0) or 0) > 0
+        return (d.get("status") != "error"
+                and (d.get("value", 0) or 0) > 0
                 and (d.get("step_time_ms", 0) or 0) > 0)
 
     if _ok(line):
@@ -108,6 +116,109 @@ def load_bank(pattern: str) -> List[Tuple[str, Dict]]:
         if m is not None:
             out.append((path, m))
     return out
+
+
+def freshest_round_is_error(pattern: str) -> Optional[str]:
+    """Path of the newest banked round when its OWN metric line is an
+    error line (usable only via last_good, or not at all); None when
+    the newest round carries a real measurement or no round exists.
+
+    This is the --predicted trigger: five straight error rounds mean
+    the measured trajectory is frozen, and gating fresh CPU rounds
+    against a stale last_good carry proves nothing about THIS change.
+    """
+    paths = sorted(glob.glob(pattern), key=_round_key)
+    if not paths:
+        return None
+    newest = paths[-1]
+    try:
+        with open(newest) as f:
+            payload = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return newest
+    text = payload.get("tail", "") if isinstance(payload, dict) else ""
+    line = extract_metric_line(text)
+    m = usable_measurement(line)
+    if m is None or m is not line:
+        return newest
+    return None
+
+
+def _pred_age_hours(rec: Dict) -> Optional[float]:
+    """Hours since the prediction record's ``banked_at`` stamp; None
+    when the stamp is missing or unparseable."""
+    import calendar
+    import time
+
+    try:
+        t = calendar.timegm(time.strptime(rec.get("banked_at", ""),
+                                          "%Y-%m-%dT%H:%M:%SZ"))
+    except (TypeError, ValueError):
+        return None
+    return (time.time() - t) / 3600.0
+
+
+def gate_predicted(fresh_glob: str, bank_dir: str,
+                   max_regress_pct: float,
+                   max_age_hours: float = 24.0) -> Tuple[bool, Dict]:
+    """Predicted-step-time gating: fresh prediction artifacts (a
+    tools/perf_gate.py run's --fresh-dir output) vs the banked
+    ``perf_pred_*.json`` baselines.  Used when the measured trajectory
+    has no fresh evidence to offer (error round) — the verdict names
+    its evidence source so a PASS can never masquerade as a hardware
+    measurement."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from tools.perf_gate import gate_one
+    except ImportError:  # script mode: tools/ is sys.path[0]
+        from perf_gate import gate_one
+
+    verdict: Dict = {"evidence_source": "predicted",
+                     "max_regress_pct": max_regress_pct,
+                     "results": []}
+    fresh_paths = sorted(glob.glob(fresh_glob))
+    if not fresh_paths:
+        verdict["error"] = (
+            f"--predicted: no fresh prediction artifacts match "
+            f"{fresh_glob!r} — run `python tools/perf_gate.py "
+            f"--fresh-dir <dir>` first (the gate must not silently "
+            "skip)")
+        return False, verdict
+    ok = True
+    for path in fresh_paths:
+        try:
+            with open(path) as f:
+                fresh = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            verdict["results"].append({"fresh": path,
+                                       "gate": "FAIL",
+                                       "error": repr(e)})
+            ok = False
+            continue
+        fresh.setdefault("key", os.path.splitext(
+            os.path.basename(path))[0].replace("perf_pred_", ""))
+        # leftovers from an earlier round must not gate THIS change:
+        # a stale fresh artifact passing silently is a green verdict
+        # for a prediction that was never computed
+        age = _pred_age_hours(fresh)
+        if age is None or age > max_age_hours:
+            verdict["results"].append({
+                "key": fresh["key"], "gate": "FAIL",
+                "error": (
+                    f"fresh prediction {path} is "
+                    f"{'unstamped' if age is None else f'{age:.1f}h old'}"
+                    f" (limit {max_age_hours}h) — re-run `python "
+                    "tools/perf_gate.py --fresh-dir <dir>` for this "
+                    "change")})
+            ok = False
+            continue
+        # ONE gating path + row schema with tools/perf_gate.py
+        row = gate_one(fresh, bank_dir, max_regress_pct,
+                       allow_missing_baseline=False)
+        verdict["results"].append(row)
+        ok = ok and row["gate"] != "FAIL"
+    return ok, verdict
 
 
 def gate(fresh: Optional[Dict], bank: List[Tuple[str, Dict]],
@@ -181,7 +292,28 @@ def main(argv=None) -> int:
                    help="exit 0 when no banked round carries a "
                         "usable measurement (first round on new "
                         "hardware)")
+    p.add_argument("--predicted", action="store_true",
+                   help="when the FRESHEST banked round is an error "
+                        "round (the r01-r05 reality), gate on the "
+                        "predicted-step-time bank instead of a stale "
+                        "last_good carry — fresh predictions from "
+                        "--pred-fresh vs artifacts/perf_pred_*.json")
+    p.add_argument("--pred-fresh", default=None,
+                   help="glob of fresh prediction artifacts (a "
+                        "tools/perf_gate.py --fresh-dir run) "
+                        "[<repo>/artifacts/perf_fresh/perf_pred_*"
+                        ".json]")
+    p.add_argument("--pred-bank", default=None,
+                   help="prediction-baseline dir "
+                        "[<repo>/artifacts]")
+    p.add_argument("--pred-max-age-hours", type=float, default=24.0,
+                   help="fresh prediction artifacts older than this "
+                        "FAIL as stale (leftovers from an earlier "
+                        "round must not gate this change) "
+                        "[%(default)s]")
     args = p.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     if args.fresh == "-":
         text = sys.stdin.read()
@@ -192,14 +324,46 @@ def main(argv=None) -> int:
 
     pattern = args.bank
     if pattern is None:
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(
-            __file__)))
         pattern = os.path.join(repo, "BENCH_r*.json")
-    bank = load_bank(pattern)
 
-    ok, verdict = gate(fresh, bank, args.max_regress_pct,
-                       allow_missing_baseline=args
-                       .allow_missing_baseline)
+    # --predicted: with the freshest banked round itself an error
+    # round AND no fresh measurement either, the measured trajectory
+    # is frozen and a fresh error line proves nothing new — delegate
+    # to the hermetic prediction bank, and SAY which evidence gated
+    # the change.  A fresh HEALTHY line always gates measured: a
+    # hardware window's real measurement is the strongest evidence of
+    # the round and can show host-side regressions the roofline model
+    # cannot see.
+    error_round = freshest_round_is_error(pattern)
+    if (args.predicted and error_round is not None
+            and (fresh is None
+                 or usable_measurement(fresh) is not fresh)):
+        print(f"bench_gate: freshest banked round {error_round} is "
+              "an error round and the fresh line carries no "
+              "measurement — gating on PREDICTED step time "
+              "(tools/perf_gate.py bank), not measured hardware "
+              "evidence", file=sys.stderr)
+        ok, verdict = gate_predicted(
+            args.pred_fresh or os.path.join(
+                repo, "artifacts", "perf_fresh", "perf_pred_*.json"),
+            args.pred_bank or os.path.join(repo, "artifacts"),
+            args.max_regress_pct,
+            max_age_hours=args.pred_max_age_hours)
+        verdict["measured_error_round"] = os.path.basename(error_round)
+    else:
+        if args.predicted:
+            why = ("the fresh line carries a real measurement"
+                   if error_round is not None
+                   else "the freshest banked round carries a real "
+                        "measurement")
+            print(f"bench_gate: {why} — gating on MEASURED evidence "
+                  "(--predicted only takes over when both are error "
+                  "rounds)", file=sys.stderr)
+        bank = load_bank(pattern)
+        ok, verdict = gate(fresh, bank, args.max_regress_pct,
+                           allow_missing_baseline=args
+                           .allow_missing_baseline)
+        verdict["evidence_source"] = "measured"
     verdict["gate"] = "PASS" if ok else "FAIL"
     print(json.dumps(verdict, indent=1))
     return 0 if ok else 1

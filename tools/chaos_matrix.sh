@@ -40,6 +40,7 @@ RUNGS=(
   "unit-telemetry|tests/test_telemetry.py tests/test_run_report.py"
   "unit-tracing|tests/test_tracing.py tests/test_bench_gate.py"
   "unit-sharding|tests/test_sharding.py"
+  "unit-perfgate|tests/test_perf_gate.py"
   "data-corrupt-jpeg|'tests/test_fault_tolerance.py::test_data_fault_rung[corrupt-jpeg]'"
   "data-missing-file|'tests/test_fault_tolerance.py::test_data_fault_rung[missing-file]'"
   "data-eio-recover|'tests/test_fault_tolerance.py::test_data_fault_rung[eio-recover]'"

@@ -1,0 +1,334 @@
+"""Hermetic predicted-step-time perf gate — no TPU, no tunnel.
+
+Every banked bench round r01–r05 reports 0.0 img/s (tunnel/backend
+failures), so ``tools/bench_gate.py`` has had nothing fresh to gate on
+for five rounds.  This tool gates what CAN be produced on every CI
+box: AOT-lower the real train step for a named TPU target under
+``JAX_PLATFORMS=cpu``, price the compiled HLO with the roofline model
+(``eksml_tpu/profiling/predict.py``), and compare the predicted step
+time — per component and total — against the banked prediction
+baseline.
+
+- **bank**: ``artifacts/perf_pred_<rung>_<strategy>_<precision>.json``
+  — one baseline per rung geometry × sharding strategy × precision.
+  ``--update-baseline`` (re)banks fresh predictions (run it once when
+  a prediction-moving change is INTENDED, and commit the diff).
+- **gate**: a fresh prediction regressing more than
+  ``--max-regress-pct`` vs its banked baseline FAILs with a
+  component-attributed message ("backbone-bwd predicted +34%"), never
+  a bare number.  A big component regression hidden by an unrelated
+  win fails too (compare_predictions).
+- **calibration**: every run reports the model's honesty — one scale
+  factor per rung fitted against the banked r5 hardware artifacts
+  (``artifacts/roi_ab_r5.json``, ``bench_rung_1344_b4.json``), with
+  the cross-rung spread printed as ``model_error_pct``.  When new
+  hardware numbers land (bench.py now emits predicted next to
+  measured), the fit tightens automatically.
+
+The model is lowered at the SMOKE channel widths (config
+SMOKE_OVERRIDES) so a CI box compiles each geometry in tens of
+seconds; the canvas/batch — what decides program structure and
+relative cost — are the real rung geometry.  Absolute milliseconds are
+therefore model-scale, not hardware-scale; the gate only ever compares
+prediction RATIOS, and the calibration section quantifies how far
+ratios can be trusted.
+
+Usage::
+
+    # CI gate (CPU-only, bounded): 2 geometries x 2 strategies
+    python tools/perf_gate.py
+
+    # accept an intended prediction change / first-time banking
+    python tools/perf_gate.py --update-baseline
+
+    # calibration report only (no lowering — pure artifact math)
+    python tools/perf_gate.py --calibrate-only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Rung geometries the predictor lowers (canvas × batch, plus the knobs
+# a rung pre-plans — mirrors bench.py RUNGS where the names overlap so
+# a measured rung pairs with its prediction by name).
+PRED_RUNGS: Dict[str, Dict[str, Any]] = {
+    "128_b1": {"image_size": 128, "batch_size": 1},
+    "256_b1": {"image_size": 256, "batch_size": 1},
+    "512_b1": {"image_size": 512, "batch_size": 1},
+    "512_b4": {"image_size": 512, "batch_size": 4},
+    "832x1344_b4": {"pad_hw": (832, 1344), "batch_size": 4},
+    "1344_b4": {"image_size": 1344, "batch_size": 4},
+    "1344_b8_remat": {"image_size": 1344, "batch_size": 8,
+                      "remat": True, "param_dtype": "bfloat16"},
+}
+
+#: the CI default: two cheap geometries × both executable strategies —
+#: ~4 tiny-model compiles, bounded minutes on one CPU core
+DEFAULT_RUNGS = "128_b1,256_b1"
+DEFAULT_STRATEGIES = "replicated,fsdp"
+
+
+def pred_key(rung: str, strategy: str, precision: str) -> str:
+    return f"{rung}_{strategy}_{precision}"
+
+
+def baseline_path(bank_dir: str, key: str) -> str:
+    return os.path.join(bank_dir, f"perf_pred_{key}.json")
+
+
+def _utcnow() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _load_json(path: str) -> Optional[Dict]:
+    # ONE loader with the calibration pairing (predict.load_json)
+    from eksml_tpu.profiling.predict import load_json
+
+    return load_json(path)
+
+
+def _rung_config(rung: str, precision: str, config_overrides):
+    """Global config → the rung's geometry at SMOKE widths, finalized.
+
+    Mutates the process-global config (the CLI owns the process); tests
+    go through the fresh_config fixture instead and call
+    predict.lower_train_step directly."""
+    from eksml_tpu.config import (SMOKE_OVERRIDES, config,
+                                  finalize_configs)
+
+    spec = PRED_RUNGS[rung]
+    size = (max(spec["pad_hw"]) if spec.get("pad_hw")
+            else spec["image_size"])
+    config.freeze(False)
+    config.update_args(SMOKE_OVERRIDES)
+    config.TRAIN.PRECISION = precision
+    config.TRAIN.REMAT = bool(spec.get("remat", False))
+    config.TRAIN.PARAM_DTYPE = spec.get("param_dtype", "float32")
+    config.TRAIN.BATCH_SIZE_PER_CHIP = spec["batch_size"]
+    config.PREPROC.MAX_SIZE = size
+    config.PREPROC.TRAIN_SHORT_EDGE_SIZE = (size, size)
+    config.update_args(config_overrides or [])
+    return finalize_configs(is_training=True)
+
+
+def predict_rung(rung: str, strategy: str, precision: str,
+                 target: str, fsdp_axis: int = 2,
+                 config_overrides=None) -> Dict[str, Any]:
+    """Lower one rung × strategy and price it for ``target`` —
+    the fresh-prediction record the gate compares and banks."""
+    from eksml_tpu.profiling import predict as P
+
+    spec = PRED_RUNGS[rung]
+    cfg = _rung_config(rung, precision, config_overrides)
+    # cfg wins over the flag: a --config TRAIN.PRECISION override
+    # changed the lowered program, and pricing/keying it as the flag
+    # precision would overwrite the wrong baseline (the bench.py
+    # re-derivation rule)
+    precision = str(cfg.TRAIN.PRECISION)
+    t0 = time.time()
+    hlo, meta = P.lower_train_step(
+        cfg, batch_size=spec["batch_size"],
+        image_size=spec.get("image_size"),
+        pad_hw=spec.get("pad_hw"), strategy=strategy,
+        fsdp_axis=fsdp_axis)
+    pred = P.predict_from_hlo(hlo, target=target, precision=precision,
+                              comm_sizes=meta["comm_sizes"])
+    rec = dict(pred)
+    rec.update({
+        "rung": rung,
+        "key": pred_key(rung, strategy, precision),
+        "strategy": strategy,
+        "geometry": {k: meta[k] for k in ("batch_size", "image_size",
+                                          "remat", "param_dtype")},
+        "mesh_shape": meta["mesh_shape"],
+        # the widths disclaimer: absolute ms are model-scale (smoke
+        # channel widths unless the caller overrode them) — gate on
+        # ratios, read the calibration section for trust bounds
+        "model_widths": "smoke",
+        "lower_seconds": round(time.time() - t0, 1),
+        "banked_at": _utcnow(),
+    })
+    return rec
+
+
+def gate_one(fresh: Dict, bank_dir: str, max_regress_pct: float,
+             allow_missing_baseline: bool) -> Dict[str, Any]:
+    """Fresh prediction vs its banked baseline → one result row."""
+    from eksml_tpu.profiling.predict import compare_predictions
+
+    path = baseline_path(bank_dir, fresh["key"])
+    base = _load_json(path)
+    row: Dict[str, Any] = {
+        "key": fresh["key"],
+        "predicted_step_time_ms": fresh["predicted_step_time_ms"],
+        "sections_ms": fresh["sections_ms"],
+        "baseline_path": os.path.relpath(path, REPO),
+    }
+    if base is None:
+        row["gate"] = "PASS" if allow_missing_baseline else "FAIL"
+        row["error"] = (
+            f"no banked baseline at {path} — run tools/perf_gate.py "
+            "--update-baseline once and commit the artifact"
+        ) if not allow_missing_baseline else None
+        row["note"] = "missing baseline"
+        return row
+    ok, verdict = compare_predictions(fresh, base,
+                                      max_regress_pct=max_regress_pct)
+    row["gate"] = "PASS" if ok else "FAIL"
+    row["verdict"] = verdict
+    if not ok:
+        row["error"] = verdict.get("error")
+    return row
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--rungs", default=DEFAULT_RUNGS,
+                   help=f"comma list of {sorted(PRED_RUNGS)} "
+                        f"[%(default)s]")
+    p.add_argument("--strategies", default=DEFAULT_STRATEGIES,
+                   help="comma list of sharding strategies to lower "
+                        "(replicated, fsdp) [%(default)s]")
+    p.add_argument("--target", default="v5e",
+                   help="chip spec the roofline prices for "
+                        "(predict.CHIP_SPECS) [%(default)s]")
+    p.add_argument("--precision", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--fsdp-axis", type=int, default=2,
+                   help="fsdp axis size for the fsdp lowering "
+                        "(host-platform virtual devices) [%(default)s]")
+    p.add_argument("--bank-dir",
+                   default=os.path.join(REPO, "artifacts"),
+                   help="where perf_pred_*.json baselines live")
+    p.add_argument("--fresh-dir", default=None,
+                   help="also write fresh predictions here (e.g. for "
+                        "bench_gate --predicted); default: only the "
+                        "verdict JSON carries them")
+    p.add_argument("--max-regress-pct", type=float, default=10.0)
+    p.add_argument("--update-baseline", action="store_true",
+                   help="(re)bank fresh predictions as the baseline "
+                        "instead of gating against it")
+    p.add_argument("--allow-missing-baseline", action="store_true")
+    p.add_argument("--calibrate-only", action="store_true",
+                   help="skip lowering; print the calibration report "
+                        "from banked artifacts (pure JSON math)")
+    p.add_argument("--out", default=None,
+                   help="write the verdict JSON here too")
+    p.add_argument("--config", nargs="*", default=[],
+                   help="KEY=VALUE config overrides applied on top of "
+                        "the rung geometry (synthetic-regression "
+                        "probes, width experiments)")
+    args = p.parse_args(argv)
+
+    # hermetic by construction: this tool only compiles — it must
+    # never touch a TPU backend or the tunnel, even on a TPU host.
+    # Env first (the fsdp lowering needs >=2 host-platform devices and
+    # XLA reads the flag at backend init), then the config pin for
+    # processes whose site hook already imported jax.  --calibrate-only
+    # never compiles, so it skips the jax import entirely (it is pure
+    # JSON math and tpu_harvest runs it on the TPU host post-window).
+    if not args.calibrate_only:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                        f"{max(2, args.fsdp_axis)}").strip()
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — backend already up
+            pass
+
+    from eksml_tpu.profiling.predict import calibrate, calibration_points
+
+    verdict: Dict[str, Any] = {
+        "target": args.target,
+        "precision": args.precision,
+        "max_regress_pct": args.max_regress_pct,
+        "model_widths": "smoke",
+        "results": [],
+    }
+
+    ok = True
+    run_precision = args.precision
+    if not args.calibrate_only:
+        rungs = [r.strip() for r in args.rungs.split(",") if r.strip()]
+        strategies = [s.strip() for s in args.strategies.split(",")
+                      if s.strip()]
+        bad = [r for r in rungs if r not in PRED_RUNGS]
+        if bad:
+            p.error(f"unknown rung(s) {bad}; known: "
+                    f"{sorted(PRED_RUNGS)}")
+        for rung in rungs:
+            for strategy in strategies:
+                print(f"perf_gate: lowering {rung} x {strategy} ...",
+                      file=sys.stderr)
+                fresh = predict_rung(
+                    rung, strategy, args.precision, args.target,
+                    fsdp_axis=args.fsdp_axis,
+                    config_overrides=args.config)
+                # the record's key, NOT pred_key(..., args.precision):
+                # a --config TRAIN.PRECISION override re-keyed the
+                # record, and writing it under the flag's key would
+                # overwrite the wrong baseline file
+                key = fresh["key"]
+                run_precision = fresh["precision"]
+                print(f"perf_gate: {key}: predicted "
+                      f"{fresh['predicted_step_time_ms']}ms "
+                      f"(lowered in {fresh['lower_seconds']}s)",
+                      file=sys.stderr)
+                if args.fresh_dir:
+                    os.makedirs(args.fresh_dir, exist_ok=True)
+                    with open(os.path.join(
+                            args.fresh_dir,
+                            f"perf_pred_{key}.json"), "w") as f:
+                        json.dump(fresh, f, indent=1)
+                if args.update_baseline:
+                    os.makedirs(args.bank_dir, exist_ok=True)
+                    path = baseline_path(args.bank_dir, key)
+                    with open(path, "w") as f:
+                        json.dump(fresh, f, indent=1)
+                    verdict["results"].append({
+                        "key": key, "gate": "BANKED",
+                        "predicted_step_time_ms":
+                            fresh["predicted_step_time_ms"],
+                        "sections_ms": fresh["sections_ms"],
+                        "baseline_path": os.path.relpath(path, REPO)})
+                else:
+                    row = gate_one(fresh, args.bank_dir,
+                                   args.max_regress_pct,
+                                   args.allow_missing_baseline)
+                    ok = ok and row["gate"] != "FAIL"
+                    verdict["results"].append(row)
+
+    # the honesty check rides every run: how far can the model's
+    # ratios be trusted, per the banked hardware evidence.
+    # run_precision, not the flag: a --config TRAIN.PRECISION
+    # override re-keyed the records, and the header/calibration must
+    # describe the precision that was actually lowered
+    verdict["precision"] = run_precision
+    verdict["calibration"] = calibrate(
+        calibration_points(args.bank_dir, precision=run_precision))
+
+    verdict["gate"] = "PASS" if ok else "FAIL"
+    payload = json.dumps(verdict, indent=1)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,10 @@ tool folds them into one reviewable report:
   1.9 s in data_wait" — via ``tools/trace_summary.py``'s merge.
 - **Modeled cost**: the attribution component table, when the run
   banked a profile.
+- **Predicted vs measured**: the perf-gate prediction bank
+  (``artifacts/perf_pred_*.json``) with the calibration fit against
+  banked hardware step times — degrades to a pointer at
+  ``tools/perf_gate.py`` when no prediction artifact exists.
 
 Usage::
 
@@ -270,8 +274,77 @@ def _attribution_section(logdir: str,
     return lines
 
 
+def _predicted_section(artifacts_dir: Optional[str]) -> List[str]:
+    """Predicted-vs-measured step-time table from the perf-gate bank
+    (ISSUE 7), degrading to a pointer exactly like the span-tracing
+    table when no prediction artifact exists."""
+    lines = ["## Predicted vs measured step time (perf gate)"]
+    if artifacts_dir is None:
+        artifacts_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))), "artifacts")
+    preds = sorted(glob.glob(os.path.join(artifacts_dir,
+                                          "perf_pred_*.json")))
+    if not preds:
+        lines += ["", "No `perf_pred_*.json` prediction artifacts in "
+                      f"`{artifacts_dir}` — run `python "
+                      "tools/perf_gate.py --update-baseline` to bank "
+                      "the hermetic roofline predictions."]
+        return lines
+    lines += ["",
+              f"{len(preds)} banked prediction(s) (smoke-width "
+              "lowering — compare ratios, not absolutes):", "",
+              "| key | predicted ms | fwd | bwd | comms | optimizer |",
+              "|---|---|---|---|---|---|"]
+    for path in preds:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            s = rec.get("sections_ms", {})
+            lines.append(
+                f"| {rec.get('key', os.path.basename(path))} "
+                f"| {rec.get('predicted_step_time_ms', '-')} "
+                f"| {s.get('fwd', '-')} | {s.get('bwd', '-')} "
+                f"| {s.get('comms', '-')} "
+                f"| {s.get('optimizer', '-')} |")
+        except (json.JSONDecodeError, OSError) as e:
+            lines.append(f"| {os.path.basename(path)} | "
+                         f"unreadable: {e!r} | | | | |")
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from eksml_tpu.profiling.predict import (calibrate,
+                                                 calibration_points)
+
+        cal = calibrate(calibration_points(artifacts_dir))
+    except Exception as e:  # noqa: BLE001 — partial evidence is fine
+        lines += ["", f"Calibration unavailable: {e!r}"]
+        return lines
+    if not cal["points"]:
+        lines += ["", "No measured-vs-predicted calibration pairs "
+                      "yet — the fit tightens when a hardware round "
+                      "lands (bench.py emits predicted alongside "
+                      "measured)."]
+        return lines
+    lines += ["",
+              f"Calibration over {cal['n_points']} hardware "
+              f"point(s): scale {cal['scale']}x, model error "
+              f"{cal['model_error_pct']}% (max per-rung deviation "
+              "from the common fit):", "",
+              "| rung | measured ms | predicted ms | scale | "
+              "deviation |",
+              "|---|---|---|---|---|"]
+    for pt in cal["points"]:
+        lines.append(
+            f"| {pt['rung']} | {pt['measured_ms']} "
+            f"| {pt['predicted_ms']} | {pt['scale']} "
+            f"| {pt['deviation_pct']}% |")
+    return lines
+
+
 def render_report(logdir: str, attribution: Optional[str] = None,
-                  max_events: int = 100) -> str:
+                  max_events: int = 100,
+                  artifacts_dir: Optional[str] = None) -> str:
     segments = load_metrics(logdir)
     events = load_events(logdir)
     lines = [f"# Run report — `{logdir}`", "",
@@ -290,6 +363,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.append("")
     lines.extend(_attribution_section(logdir, attribution))
     lines.append("")
+    lines.extend(_predicted_section(artifacts_dir))
+    lines.append("")
     return "\n".join(lines)
 
 
@@ -303,10 +378,14 @@ def main(argv=None) -> int:
                         "<logdir>/profile/attribution.json)")
     p.add_argument("--max-events", type=int, default=100,
                    help="cap on timeline rows (newest kept)")
+    p.add_argument("--artifacts", default=None,
+                   help="perf-gate artifact dir for the predicted-vs-"
+                        "measured table (default: <repo>/artifacts)")
     args = p.parse_args(argv)
 
     report = render_report(args.logdir, attribution=args.attribution,
-                           max_events=args.max_events)
+                           max_events=args.max_events,
+                           artifacts_dir=args.artifacts)
     if args.out:
         with open(args.out, "w") as f:
             f.write(report)

@@ -114,4 +114,22 @@ python tools/op_microbench.py --iters 20 --image-size 1344 \
     && say "op_microbench banked: $(head -c 300 artifacts/op_microbench_tpu.json 2>/dev/null)" \
     || say "op_microbench FAILED (see $LOG)"
 
+# ---- 5. fresh calibration point for the hermetic perf gate ---------
+# Every rung artifact the ladder just banked carries BOTH measured and
+# predicted step time (bench.py emits them side by side since ISSUE
+# 7), so the roofline model's honesty check gains a fresh hardware
+# point the moment the window closes.  Pure CPU JSON math — no
+# tunnel, runs even if every hardware block above failed (it then
+# re-reports the r5-based fit unchanged).
+say "perf-gate calibration (predicted vs this window's measurements)"
+JAX_PLATFORMS=cpu python tools/perf_gate.py --calibrate-only \
+    --out artifacts/perf_calibration_r6.json >> "$LOG" 2>&1 \
+    && say "calibration banked: $(python -c "
+import json
+d = json.load(open('artifacts/perf_calibration_r6.json'))
+c = d.get('calibration', {})
+print('points', c.get('n_points'), 'scale', c.get('scale'),
+      'model_error_pct', c.get('model_error_pct'))" 2>/dev/null)" \
+    || say "calibration FAILED (see $LOG)"
+
 say "r6 harvest complete"
