@@ -33,6 +33,8 @@ import os
 import sys
 import time
 
+from eksml_tpu.fsio import atomic_write_json, atomic_write_text
+
 # Approximate per-V100 throughput of the reference's optimized stack
 # (aws-samples mask-rcnn-tensorflow, fp16, batch 4). Used only to give
 # vs_baseline a denominator; the reference repo itself publishes none.
@@ -96,8 +98,7 @@ def _bank(path: str, diag: dict) -> None:
         rec = dict(diag)
         rec["banked_at"] = utcnow()
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(rec, f, indent=1)
+        atomic_write_json(path, rec)
     except OSError as e:
         print(f"bench: could not bank {path}: {e}", file=sys.stderr)
 
@@ -545,8 +546,7 @@ def _bank_attribution(step, diag: dict) -> None:
         from eksml_tpu.profiling import write_attribution_artifact
 
         os.makedirs("profile", exist_ok=True)
-        with open(os.path.join("profile", "hlo.txt"), "w") as f:
-            f.write(hlo)
+        atomic_write_text(os.path.join("profile", "hlo.txt"), hlo)
         payload = write_attribution_artifact(
             hlo, os.path.join("profile", "attribution.json"),
             extra={"operating_point": diag.get("operating_point"),
@@ -632,8 +632,16 @@ def run(args, diag: dict) -> None:
     dev_kind = devices[0].device_kind
     diag["device_kind"] = dev_kind
     diag["n_devices"] = n_dev
-    print(f"bench: {n_dev}x {dev_kind}, batch={args.batch_size}, "
-          f"image={shape}, {args.precision}, "
+    # cfg, not the flags: a --config override may have shadowed the
+    # batch/precision flags above (the PR 6/7 re-derivation rule; the
+    # banner and every consumer below must describe what is measured).
+    # The diag fields are corrected HERE, before any consumer — the
+    # --profile attribution artifact banks diag["batch_size"] mid-run
+    batch_per_chip = int(cfg.TRAIN.BATCH_SIZE_PER_CHIP)
+    diag["batch_size"] = batch_per_chip
+    diag["precision"] = str(cfg.TRAIN.PRECISION)
+    print(f"bench: {n_dev}x {dev_kind}, batch={batch_per_chip}, "
+          f"image={shape}, {cfg.TRAIN.PRECISION}, "
           f"roi={args.roi_backend}", file=sys.stderr)
 
     fwd_only = getattr(args, "forward_only", False)
@@ -672,7 +680,7 @@ def run(args, diag: dict) -> None:
     if prefetch >= 0:
         host_batches = [
             {k: v for k, v in make_synthetic_batch(
-                cfg, batch_size=args.batch_size, image_size=shape,
+                cfg, batch_size=batch_per_chip, image_size=shape,
                 seed=s).items() if k not in ("image_scale", "image_id")}
             for s in range(4)]
         batch = jax.device_put(host_batches[0])
@@ -682,7 +690,7 @@ def run(args, diag: dict) -> None:
         # trainer's TRAIN.BATCH_SIZE_PER_CHIP semantics — the batch
         # axis must divide over data×fsdp); the historical no-plan
         # path keeps batch_size total rows on one device
-        global_bs = args.batch_size * (n_dev if plan is not None else 1)
+        global_bs = batch_per_chip * (n_dev if plan is not None else 1)
         batch = make_synthetic_batch(cfg, batch_size=global_bs,
                                      image_size=shape)
         batch = {k: jnp.asarray(v) for k, v in batch.items()
@@ -844,7 +852,7 @@ def run(args, diag: dict) -> None:
     assert np.isfinite(float(loss)), f"non-finite loss {float(loss)}"
     # under a plan each step consumes batch_size rows on EVERY chip;
     # the legacy path's step is batch_size rows total
-    imgs_per_step = args.batch_size * (n_dev if plan is not None else 1)
+    imgs_per_step = batch_per_chip * (n_dev if plan is not None else 1)
     imgs_per_sec = args.steps * imgs_per_step / dt
     per_chip = imgs_per_sec / max(1, n_dev)
     step_ms = dt / args.steps * 1000

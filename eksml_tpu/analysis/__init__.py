@@ -1,0 +1,35 @@
+"""eksml-lint: framework-invariant static analysis.
+
+Seven PRs of code review kept re-finding the same defect classes by
+hand; this package checks them mechanically so every future PR —
+serving, elastic topology, new workloads — inherits the invariants
+without reviewer memory:
+
+- ``jit-purity``        — functions reachable from a jitted step fn
+  must be trace-pure (no wall clock, host RNG, env mutation, host I/O)
+- ``config-drift``      — after ``--config`` overrides land via
+  ``update_args``, the shadowed argparse attribute must not be read
+  (PR 6 bench sharding, PR 7 precision — twice)
+- ``signal-safety``     — ``signal.signal`` handlers are flag-only: no
+  registry/recorder/logging/lock acquisition in their call graph
+  (PR 4's SIGTERM deadlock)
+- ``atomic-write``      — artifact writes follow write-then-
+  ``os.replace`` so a reader never sees a torn file
+- ``scope-coverage``    — every ``jax.named_scope`` resolves under
+  ``profiling.attribution.SCOPE_RULES`` and every rule keeps an anchor
+  in the tree, so attribution's "other" bucket can't regress silently
+- ``values-config-sync``— chart values keys render into ``--config``
+  keys that exist in config.py, and no values key goes dead
+
+Entry point: ``tools/eksml_lint.py`` (JSON + human output, committed
+baseline, ``# eksml-lint: disable=<rule>`` suppressions, nonzero exit
+on any non-baselined finding — a tier-1 gate via tests/test_lint.py).
+"""
+
+from eksml_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    load_baseline,
+    run_lint,
+)
+from eksml_tpu.analysis.checkers import ALL_RULES  # noqa: F401

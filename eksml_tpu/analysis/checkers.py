@@ -1,0 +1,788 @@
+"""The five framework-aware checkers (+ chart/values cross-check).
+
+Each checker encodes an invariant a past PR's review re-found by hand
+(see package docstring).  They are deliberately *framework-aware*: the
+patterns key off this repo's idioms — ``cfg.update_args`` as the
+override point, ``plan.jit``/``jax.jit`` as the trace boundary,
+``signal.signal`` registration, the write-then-``os.replace`` artifact
+idiom, and the ``jax.named_scope`` ↔ ``SCOPE_RULES`` contract.
+
+Static-analysis scope: call graphs resolve within one module (plain
+``f()`` calls and ``self.m()``/``cls.m()`` methods).  Cross-module
+reachability is out of scope — the invariants live where the pattern
+and its hazard share a file, which is everywhere they have bitten.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from eksml_tpu.analysis.engine import Finding, ModuleInfo
+
+RULE_JIT = "jit-purity"
+RULE_DRIFT = "config-drift"
+RULE_SIGNAL = "signal-safety"
+RULE_ATOMIC = "atomic-write"
+RULE_SCOPE = "scope-coverage"
+RULE_VALUES = "values-config-sync"
+
+ALL_RULES = (RULE_JIT, RULE_DRIFT, RULE_SIGNAL, RULE_ATOMIC,
+             RULE_SCOPE, RULE_VALUES)
+
+
+# -- shared AST helpers ----------------------------------------------
+
+def _chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """``a.b.c`` → ("a", "b", "c"); None when the root isn't a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return "<expr>"
+
+
+class _CallGraph:
+    """Intra-module call graph over bare function names.
+
+    Resolves ``f()`` and ``self.m()``/``cls.m()`` calls to any
+    same-named def in the module (an over-approximation that errs
+    toward checking more code, never less).
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.defs: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+    @staticmethod
+    def _callees(func: ast.AST) -> set:
+        out = set()
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif (isinstance(f, ast.Attribute)
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in ("self", "cls")):
+                out.add(f.attr)
+        return out
+
+    def reachable(self, roots: Iterable[ast.AST]) -> List[ast.AST]:
+        seen_ids, order, stack = set(), [], list(roots)
+        while stack:
+            fn = stack.pop()
+            if id(fn) in seen_ids:
+                continue
+            seen_ids.add(id(fn))
+            order.append(fn)
+            for name in self._callees(fn):
+                stack.extend(self.defs.get(name, ()))
+        return order
+
+
+# -- 1. jit-purity ----------------------------------------------------
+
+_JIT_NAMES = ("jit", "pjit", "pmap")
+#: os helpers that touch the filesystem — host I/O under a trace.
+_OS_IO = ("replace", "remove", "rename", "makedirs", "unlink", "rmdir",
+          "mkdir", "symlink")
+_ENV_MUTATORS = ("update", "setdefault", "pop", "clear", "popitem")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    c = _chain(node)
+    return c is not None and c[-1] in _JIT_NAMES
+
+
+class JitPurityChecker:
+    """Functions reachable from a jitted step fn must be trace-pure.
+
+    A ``time.*`` read, host RNG draw, ``os.environ`` mutation, or host
+    I/O inside a traced function runs ONCE at trace time: the value is
+    baked into the compiled program (non-determinism across compiles,
+    cache-key poisoning) and the side effect silently never recurs.
+    """
+
+    rule = RULE_JIT
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        graph = _CallGraph(mod.tree)
+        roots: List[Tuple[str, ast.AST]] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._decorator_is_jit(dec):
+                        roots.append((node.name, node))
+            elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                roots.extend(self._call_roots(node, graph))
+        findings: List[Finding] = []
+        reported: set = set()  # node ids — two roots reaching the
+        for root_name, root in roots:  # same helper report it once
+            for fn in graph.reachable([root]):
+                findings.extend(self._scan(mod, fn, root_name,
+                                           reported))
+        return findings
+
+    @staticmethod
+    def _decorator_is_jit(dec: ast.AST) -> bool:
+        if _is_jit_expr(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_expr(dec.func):
+                return True           # @jax.jit(static_argnums=...)
+            c = _chain(dec.func)
+            if (c and c[-1] == "partial" and dec.args
+                    and _is_jit_expr(dec.args[0])):
+                return True           # @partial(jax.jit, ...)
+        return False
+
+    @staticmethod
+    def _call_roots(node: ast.Call, graph: _CallGraph
+                    ) -> List[Tuple[str, ast.AST]]:
+        if not node.args:
+            return []
+        target = node.args[0]
+        if isinstance(target, ast.Lambda):
+            return [("<lambda>", target)]
+        name = None
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr      # plan.jit(self._train_step, ...)
+        if name is None:
+            return []
+        return [(name, fn) for fn in graph.defs.get(name, ())]
+
+    def _scan(self, mod: ModuleInfo, fn: ast.AST, root: str,
+              reported: set) -> List[Finding]:
+        out = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            if (id(node), what) in reported:
+                return
+            reported.add((id(node), what))
+            out.append(mod.finding(
+                self.rule, node.lineno,
+                f"{what} inside code reachable from jit-wrapped "
+                f"'{root}' — traced functions run once at compile; "
+                "hoist to the host side or use jax.random/"
+                "jax.debug.*"))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                c = _chain(node.func)
+                if c is None:
+                    continue
+                if c[0] == "time" and len(c) == 2:
+                    flag(node, f"wall-clock read {'.'.join(c)}()")
+                elif c[0] in ("np", "numpy") and len(c) >= 2 \
+                        and c[1] == "random":
+                    flag(node, f"host RNG {'.'.join(c)}()")
+                elif c[0] == "random" and len(c) == 2:
+                    flag(node, f"host RNG {'.'.join(c)}()")
+                elif c[:2] == ("os", "environ") and len(c) == 3 \
+                        and c[2] in _ENV_MUTATORS:
+                    flag(node, f"os.environ mutation .{c[2]}()")
+                elif c == ("os", "putenv") or c == ("os", "unsetenv"):
+                    flag(node, f"{'.'.join(c)}() env mutation")
+                elif c[0] == "os" and len(c) == 2 and c[1] in _OS_IO:
+                    flag(node, f"host I/O {'.'.join(c)}()")
+                elif c[0] == "shutil":
+                    flag(node, f"host I/O {'.'.join(c)}()")
+                elif c == ("open",) or c == ("print",):
+                    flag(node, f"host I/O {c[0]}()")
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.Delete)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [getattr(node, "target", None)]
+                           if not isinstance(node, ast.Delete)
+                           else node.targets)
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _chain(t.value) == ("os", "environ"):
+                        flag(node, "os.environ[...] mutation")
+        return out
+
+
+# -- 2. config-drift --------------------------------------------------
+
+_CFG_ROOTS = ("cfg", "config", "_C")
+
+
+def _is_cfg_root(name: str) -> bool:
+    return name in _CFG_ROOTS or "cfg" in name.lower()
+
+
+def _args_reads(node: ast.AST) -> List[Tuple[str, int]]:
+    """[(attr, lineno)] for every ``args.X`` load / getattr(args, "X")
+    in *node*'s subtree (stores excluded)."""
+    out = []
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "args"
+                and isinstance(n.ctx, ast.Load)):
+            out.append((n.attr, n.lineno))
+        elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+              and n.func.id == "getattr" and len(n.args) >= 2
+              and isinstance(n.args[0], ast.Name)
+              and n.args[0].id == "args"
+              and isinstance(n.args[1], ast.Constant)
+              and isinstance(n.args[1].value, str)):
+            out.append((n.args[1].value, n.lineno))
+    return out
+
+
+class ConfigDriftChecker:
+    """No ``args.X`` reads after ``--config`` overrides land.
+
+    When a function copies ``args.X`` into the config tree and then
+    applies ``cfg.update_args(args.config)``, the config — not the
+    argparse namespace — is the source of truth: a ``--config``
+    override may have shadowed the flag (PR 6 measured the replicated
+    path while the JSON claimed fsdp; PR 7 priced the wrong peak-flops
+    row, twice).  Re-read the ``cfg.*`` path instead.
+    """
+
+    rule = RULE_DRIFT
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(mod, fn))
+        return findings
+
+    def _check_fn(self, mod: ModuleInfo, fn: ast.AST) -> List[Finding]:
+        shadow: Dict[str, Tuple[int, str]] = {}
+        override_line = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    c = _chain(t) if isinstance(t, ast.Attribute) else None
+                    if c and _is_cfg_root(c[0]):
+                        for attr, _ in _args_reads(node.value):
+                            if attr not in shadow:
+                                shadow[attr] = (node.lineno,
+                                                _unparse(t))
+            elif isinstance(node, ast.Call):
+                c = _chain(node.func)
+                if c and ((c[-1] == "update_args"
+                           and len(c) >= 2 and _is_cfg_root(c[0]))
+                          or c[-1] == "apply_overrides"):
+                    if override_line is None \
+                            or node.lineno < override_line:
+                        override_line = node.lineno
+        if override_line is None or not shadow:
+            return []
+        out = []
+        for attr, lineno in _args_reads(fn):
+            if attr in shadow and lineno > override_line:
+                copy_line, cfg_path = shadow[attr]
+                out.append(mod.finding(
+                    self.rule, lineno,
+                    f"args.{attr} read after --config overrides "
+                    f"landed (line {override_line}); line {copy_line} "
+                    f"copied it into {cfg_path}, so an override may "
+                    f"have shadowed the flag — read {cfg_path} "
+                    "instead"))
+        return out
+
+
+# -- 3. signal-safety -------------------------------------------------
+
+_LOG_ROOTS = ("log", "logger", "logging")
+_TELEMETRY_ROOTS = ("telemetry", "recorder", "registry", "metrics")
+_METRIC_OPS = ("inc", "dec", "observe", "event", "add_event")
+
+
+class SignalSafetyChecker:
+    """``signal.signal`` handlers must be flag-only.
+
+    A handler runs between bytecodes ON the interrupted main thread.
+    Anything that takes a lock the interrupted code may already hold —
+    the telemetry registry/recorder, the logging module, an explicit
+    ``.acquire()`` — deadlocks before the flag is set and the forced
+    checkpoint never happens (PR 4's SIGTERM deadlock).  Set a flag;
+    publish at the next step boundary.
+    """
+
+    rule = RULE_SIGNAL
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        graph = _CallGraph(mod.tree)
+        findings: List[Finding] = []
+        reported: set = set()  # node ids — one handler registered for
+        for node in ast.walk(mod.tree):  # N signals reports once
+            if not (isinstance(node, ast.Call)
+                    and _chain(node.func) == ("signal", "signal")
+                    and len(node.args) >= 2):
+                continue
+            handler = node.args[1]
+            roots: List[ast.AST] = []
+            if isinstance(handler, ast.Lambda):
+                roots = [handler]
+            else:
+                name = None
+                if isinstance(handler, ast.Name):
+                    name = handler.id
+                elif isinstance(handler, ast.Attribute):
+                    name = handler.attr
+                if name is not None:
+                    roots = list(graph.defs.get(name, ()))
+                # unresolved (restoring a saved previous handler,
+                # signal.SIG_DFL/SIG_IGN) — nothing to check
+            for root in roots:
+                root_name = getattr(root, "name", "<lambda>")
+                for fn in graph.reachable([root]):
+                    findings.extend(self._scan(mod, fn, root_name,
+                                               reported))
+        return findings
+
+    def _scan(self, mod: ModuleInfo, fn: ast.AST, root: str,
+              reported: set) -> List[Finding]:
+        out = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            if (id(node), what) in reported:
+                return
+            reported.add((id(node), what))
+            out.append(mod.finding(
+                self.rule, node.lineno,
+                f"{what} in signal handler '{root}' call graph — "
+                "handlers run between bytecodes on the interrupted "
+                "thread and deadlock on any lock it already holds; "
+                "set a flag and publish at the next step boundary"))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                c = _chain(node.func)
+                if c is None:
+                    # chained call results (registry.counter(...).inc())
+                    # have no Name root; the method name still tells
+                    if isinstance(node.func, ast.Attribute):
+                        attr = node.func.attr
+                        if attr in _METRIC_OPS:
+                            flag(node, f"telemetry call .{attr}()")
+                        elif attr == "acquire":
+                            flag(node, f"lock acquisition .{attr}()")
+                    continue
+                if c[0] in _LOG_ROOTS and len(c) >= 2:
+                    flag(node, f"logging call {'.'.join(c)}()")
+                elif c[-1] == "acquire":
+                    flag(node, f"lock acquisition {'.'.join(c)}()")
+                elif c[-1] in _METRIC_OPS and len(c) >= 2:
+                    # receiver required: a bare Name call resolves
+                    # through the call graph instead, so a local
+                    # helper named event()/inc() is judged by what
+                    # it actually does, not by its name
+                    flag(node, f"telemetry call {'.'.join(c)}()")
+                elif c[0] in _TELEMETRY_ROOTS and len(c) >= 2:
+                    flag(node, f"telemetry call {'.'.join(c)}()")
+                elif c == ("open",) or c == ("print",):
+                    flag(node, f"host I/O {c[0]}() ")
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    src = _unparse(item.context_expr).lower()
+                    if "lock" in src or "condition" in src:
+                        flag(node, f"lock acquisition "
+                                   f"'with {_unparse(item.context_expr)}'")
+        return out
+
+
+# -- 4. atomic-write --------------------------------------------------
+
+class AtomicWriteChecker:
+    """Artifact writes must be write-then-``os.replace``.
+
+    A plain ``open(path, "w")`` truncates in place: a concurrent
+    reader (bench_gate tailing a bank, a scraper polling a port file,
+    a resumed run loading a baseline) sees an empty or torn file, and
+    a crash mid-write destroys the previous good artifact.  Write to
+    a temp name in the same directory, then ``os.replace(tmp, path)``
+    — atomic on POSIX.  Append-mode streams (``"a"``) are exempt: the
+    jsonl mirror idiom is line-buffered appends.
+    """
+
+    rule = RULE_ATOMIC
+
+    def check(self, mod: ModuleInfo) -> List[Finding]:
+        # innermost enclosing function per node (ast.walk is outer-
+        # first, so nested defs overwrite their own nodes' owner);
+        # None = module level.  The compliance window for an open() is
+        # its own scope: the tmp-write and the os.replace of the same
+        # expression belong together.
+        owner: Dict[int, ast.AST] = {}
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for n in ast.walk(fn):
+                    if n is not fn:
+                        owner[id(n)] = fn
+
+        opens: List[Tuple[ast.Call, Optional[ast.AST]]] = []
+        replaced: Dict[Optional[int], set] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            c = _chain(node.func)
+            scope = owner.get(id(node))
+            if c == ("open",) and self._write_mode(node):
+                opens.append((node, scope))
+            elif c in (("os", "replace"), ("os", "rename"),
+                       ("shutil", "move")) and node.args:
+                replaced.setdefault(
+                    id(scope) if scope else None,
+                    set()).add(_unparse(node.args[0]))
+
+        out = []
+        for node, scope in opens:
+            path_src = _unparse(node.args[0]) if node.args else "?"
+            scope_replaced = replaced.get(
+                id(scope) if scope else None, set())
+            if path_src in scope_replaced:
+                continue
+            if "devnull" in path_src or "/dev/null" in path_src:
+                continue
+            out.append(mod.finding(
+                self.rule, node.lineno,
+                f"open({path_src}, 'w') without write-then-os.replace"
+                " — a concurrent reader sees a torn/empty artifact "
+                "and a crash mid-write destroys the previous good "
+                "one; write to a '.tmp' sibling and os.replace it"))
+        return out
+
+    @staticmethod
+    def _write_mode(node: ast.Call) -> bool:
+        mode = None
+        if len(node.args) >= 2 and isinstance(node.args[1],
+                                              ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        return isinstance(mode, str) and mode.startswith("w")
+
+
+# -- 5. scope-coverage ------------------------------------------------
+
+_SCOPE_DIRS = ("eksml_tpu/models/", "eksml_tpu/ops/")
+_SCOPE_FILES = ("eksml_tpu/train.py",)
+_ATTRIBUTION = "eksml_tpu/profiling/attribution.py"
+
+
+def _literal_name(node: ast.AST) -> Optional[str]:
+    """Constant str, or an f-string with formatted parts → "0" (so
+    ``f"cascade{i}"`` matches the ``cascade\\d*`` rule pattern)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("0")
+        return "".join(parts)
+    return None
+
+
+class ScopeCoverageChecker:
+    """The ``jax.named_scope`` ↔ ``SCOPE_RULES`` contract, statically.
+
+    Two drift directions, both of which silently inflate attribution's
+    "other" bucket (the roofline/perf-gate stack keys off component
+    shares):
+
+    1. a scope name in the tree that no ``SCOPE_RULES`` pattern
+       resolves — its cost lands in "other";
+    2. a ``SCOPE_RULES`` component with no remaining anchor in the
+       tree (scope renamed/removed in code but not in the rules) —
+       the component silently reads zero.
+
+    Anchors are ``jax.named_scope`` literals plus flax submodule
+    ``name="..."`` kwargs under models/ (the module-path half of the
+    op_name metadata the rules match).
+    """
+
+    rule = RULE_SCOPE
+
+    def check_project(self, mods: Dict[str, ModuleInfo],
+                      repo_root: str) -> List[Finding]:
+        try:
+            from eksml_tpu.profiling.attribution import (
+                SCOPE_RULES, resolve_component)
+        except Exception as e:  # noqa: BLE001 — degrade loudly
+            return [Finding(self.rule, _ATTRIBUTION, 0,
+                            f"cannot import SCOPE_RULES: {e}",
+                            context="import SCOPE_RULES")]
+
+        scopes: List[Tuple[str, ModuleInfo, int]] = []
+        anchors: List[str] = []
+        for path, mod in mods.items():
+            in_scope = (path in _SCOPE_FILES
+                        or any(path.startswith(d) for d in _SCOPE_DIRS))
+            if not in_scope:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                c = _chain(node.func)
+                if c and c[-1] == "named_scope" and node.args:
+                    lit = _literal_name(node.args[0])
+                    if lit is not None:
+                        scopes.append((lit, mod, node.lineno))
+                        anchors.append(lit)
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        lit = _literal_name(kw.value)
+                        if lit is not None:
+                            anchors.append(lit)
+
+        findings: List[Finding] = []
+        for lit, mod, lineno in scopes:
+            if resolve_component(lit.lower()) is None:
+                findings.append(mod.finding(
+                    self.rule, lineno,
+                    f"jax.named_scope({lit!r}) resolves to no "
+                    "SCOPE_RULES component — its cost lands in "
+                    "attribution's 'other' bucket; add a rule in "
+                    "profiling/attribution.py or reuse an existing "
+                    "scope name"))
+
+        # rule-anchor direction needs the real attribution module in
+        # the linted set (fixture trees check direction 1 only)
+        attr_mod = mods.get(_ATTRIBUTION)
+        if attr_mod is not None:
+            lowered = [a.lower() for a in anchors]
+            for comp, pat, _bwd in SCOPE_RULES:
+                rx = re.compile(pat)
+                if not any(rx.search(a) for a in lowered):
+                    findings.append(attr_mod.finding(
+                        self.rule,
+                        self._rule_line(attr_mod, comp),
+                        f"SCOPE_RULES component {comp!r} has no "
+                        "anchoring jax.named_scope or flax name= in "
+                        "models//ops//train.py — the component "
+                        "silently reads zero; re-anchor the scope or "
+                        "drop the rule"))
+        return findings
+
+    @staticmethod
+    def _rule_line(mod: ModuleInfo, comp: str) -> int:
+        needle = f'("{comp}"'
+        for i, line in enumerate(mod.lines, start=1):
+            if needle in line:
+                return i
+        return 0
+
+
+# -- 6. values-config-sync --------------------------------------------
+
+_CONFIG_KEY_RE = re.compile(r"^([A-Z][A-Z0-9_]*(?:\.[A-Z0-9_]+)*)=")
+
+
+class ValuesConfigSyncChecker:
+    """Chart values render into config keys that actually exist.
+
+    The charts' values.yaml keys become ``--config KEY=VALUE`` argv via
+    the templates; ``AttrDict.update_args`` raises on an unknown key,
+    so drift between a chart and ``config.py`` is a pod that dies at
+    start.  Checked by rendering both charts with the in-repo resolver
+    (tools/render_charts.py) and resolving every rendered KEY against
+    the default config tree.  Also flags values.yaml keys the template
+    never references (dead values keys — the other drift direction).
+    """
+
+    rule = RULE_VALUES
+
+    def check_project(self, mods: Dict[str, ModuleInfo],
+                      repo_root: str) -> List[Finding]:
+        if not os.path.isdir(os.path.join(repo_root, "charts")):
+            return []
+        try:
+            rc = self._load_render_charts(repo_root)
+            import yaml
+        except Exception as e:  # noqa: BLE001 — degrade loudly
+            return [Finding(self.rule, "tools/render_charts.py", 0,
+                            f"cannot load chart resolver: {e}")]
+        from eksml_tpu.config import config as default_cfg
+        from eksml_tpu.config import AttrDict
+
+        findings: List[Finding] = []
+        for chart in rc.CHARTS:
+            values_rel = f"{chart}/values.yaml"
+            try:
+                rendered = rc.render_chart(chart)
+            except Exception as e:  # noqa: BLE001
+                findings.append(Finding(
+                    self.rule, values_rel, 0,
+                    f"chart fails to render: {e}",
+                    context=f"render {chart}"))
+                continue
+            main_doc = rendered.get(f"{os.path.basename(chart)}"
+                                    f"__maskrcnn.yaml")
+            if main_doc is None:
+                # a chart whose main template isn't maskrcnn.yaml
+                # (e.g. a future serving chart) degrades to a finding
+                # like the other failure paths, never a crash
+                findings.append(Finding(
+                    self.rule, values_rel, 0,
+                    "chart renders no <chart>__maskrcnn.yaml main "
+                    "manifest — teach values-config-sync this "
+                    "chart's layout",
+                    context=f"layout {chart}"))
+                continue
+            for key in self._rendered_config_keys(yaml, main_doc):
+                try:
+                    leaf = default_cfg.get_path(key)
+                    if isinstance(leaf, AttrDict):
+                        raise AttributeError("not a leaf")
+                except (AttributeError, KeyError):
+                    # anchor at the SOURCE of the key — the template
+                    # line rendering it, or the values.yaml line
+                    # (extra_config) — so path/line/context are real
+                    # and baseline keys stay per-defect unique
+                    path, lineno, ctx = self._key_source(
+                        repo_root, chart, key)
+                    findings.append(Finding(
+                        self.rule, path, lineno,
+                        f"chart renders --config {key}=… but "
+                        "config.py has no such knob — the trainer "
+                        "dies at startup with 'unknown config key'; "
+                        "sync the template/values with config.py",
+                        context=ctx))
+            findings.extend(self._dead_values_keys(
+                yaml, repo_root, chart))
+        return findings
+
+    @staticmethod
+    def _key_source(repo_root: str, chart: str, key: str
+                    ) -> Tuple[str, int, str]:
+        """Locate ``KEY=`` in the chart sources (templates first, then
+        values.yaml for extra_config keys)."""
+        candidates = []
+        tdir = os.path.join(repo_root, chart, "templates")
+        try:
+            for name in sorted(os.listdir(tdir)):
+                candidates.append(f"{chart}/templates/{name}")
+        except OSError:
+            pass  # templates-less chart: fall through to values.yaml
+        candidates.append(f"{chart}/values.yaml")
+        for rel in candidates:
+            try:
+                with open(os.path.join(repo_root, rel)) as f:
+                    for i, line in enumerate(f, start=1):
+                        if f"{key}=" in line:
+                            return rel, i, line.strip()
+            except OSError:
+                continue
+        return f"{chart}/values.yaml", 0, f"--config {key}"
+
+    @staticmethod
+    def _load_render_charts(repo_root: str):
+        import importlib.util
+
+        path = os.path.join(repo_root, "tools", "render_charts.py")
+        spec = importlib.util.spec_from_file_location(
+            "eksml_render_charts", path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+    @staticmethod
+    def _rendered_config_keys(yaml, manifest_text: str) -> List[str]:
+        """Every KEY rendered after ``--config`` in any container
+        command of the manifest."""
+        keys: List[str] = []
+
+        def walk(node):
+            if isinstance(node, dict):
+                for v in node.values():
+                    walk(v)
+            elif isinstance(node, list):
+                if "--config" in node:
+                    start = node.index("--config") + 1
+                    for item in node[start:]:
+                        if not isinstance(item, str):
+                            continue
+                        m = _CONFIG_KEY_RE.match(item)
+                        if m:
+                            keys.append(m.group(1))
+                for v in node:
+                    walk(v)
+
+        for doc in yaml.safe_load_all(manifest_text):
+            if doc:
+                walk(doc)
+        return keys
+
+    def _dead_values_keys(self, yaml, repo_root: str, chart: str
+                          ) -> List[Finding]:
+        values_rel = f"{chart}/values.yaml"
+        values_abs = os.path.join(repo_root, values_rel)
+        template_text = ""
+        tdir = os.path.join(repo_root, chart, "templates")
+        for name in sorted(os.listdir(tdir)):
+            with open(os.path.join(tdir, name)) as f:
+                template_text += f.read()
+        with open(values_abs) as f:
+            values_src = f.read()
+        values = yaml.safe_load(values_src)
+        out = []
+        for key in (values.get("maskrcnn") or {}):
+            # \b: `chips` must not count as referenced just because
+            # `chips_per_host` is (prefix keys exist in both charts)
+            if re.search(r"\.Values\.maskrcnn\." + re.escape(key)
+                         + r"\b", template_text):
+                continue
+            lineno, ctx = 0, f"maskrcnn.{key}:"
+            for i, line in enumerate(values_src.splitlines(), start=1):
+                if line.strip().startswith(f"{key}:"):
+                    lineno, ctx = i, line.strip()
+                    break
+            out.append(Finding(
+                self.rule, values_rel, lineno,
+                f"values key maskrcnn.{key} is never referenced by "
+                "the chart templates — dead knob (operators setting "
+                "it silently change nothing); wire it or drop it",
+                context=ctx))
+        return out
+
+
+# -- registry ---------------------------------------------------------
+
+def build_checkers(rules: Optional[Sequence[str]] = None):
+    """(module_checkers, project_checkers) filtered by rule name."""
+    module_checkers = [JitPurityChecker(), ConfigDriftChecker(),
+                       SignalSafetyChecker(), AtomicWriteChecker()]
+    project_checkers = [ScopeCoverageChecker(),
+                        ValuesConfigSyncChecker()]
+    if rules is not None:
+        wanted = set(rules)
+        unknown = wanted - set(ALL_RULES)
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; "
+                f"known: {list(ALL_RULES)}")
+        module_checkers = [c for c in module_checkers
+                           if c.rule in wanted]
+        project_checkers = [c for c in project_checkers
+                            if c.rule in wanted]
+    return module_checkers, project_checkers

@@ -1,0 +1,37 @@
+"""Atomic artifact writes — ONE implementation of the idiom.
+
+The write-then-``os.replace`` discipline (enforced by the
+``atomic-write`` lint rule, eksml_tpu/analysis/): write the payload to
+a ``.tmp`` sibling in the same directory, then ``os.replace`` it over
+the destination — atomic on POSIX, so a concurrent reader (bench_gate
+tailing a bank, a scraper polling a port file, an operator tailing a
+report) never sees a torn or empty file and a crash mid-write never
+destroys the previous good artifact.
+
+Stdlib-only on purpose: importable from every tool and package module
+without pulling jax/orbax (which is why this lives at the package top
+level, not under ``utils/`` whose ``__init__`` imports Orbax).
+Dependency-light standalone tools (render_charts, make_coco_subset)
+keep the same idiom inline instead of importing the package.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def atomic_write_json(path: str, obj: Any, indent: int = 1,
+                      **kwargs: Any) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent, **kwargs)
+    os.replace(tmp, path)

@@ -34,6 +34,8 @@ import json
 import re
 from typing import Dict, List, Optional, Tuple
 
+from eksml_tpu.fsio import atomic_write_json
+
 # bytes per element for HLO shape tokens
 DTYPE_BYTES = {
     "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
@@ -448,6 +450,5 @@ def write_attribution_artifact(hlo_text: str, path: str,
     }
     if extra:
         payload.update(extra)
-    with open(path, "w") as f:
-        json.dump(payload, f, indent=1)
+    atomic_write_json(path, payload)
     return payload

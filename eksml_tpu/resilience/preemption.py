@@ -93,8 +93,11 @@ class PreemptionHandler:
             self.signal_time = time.time()
             # log from signal context is re-entrant-unsafe in theory;
             # in practice the logging module masks its own locks and
-            # this fires once.  Keep it to one line.
-            log.warning("received signal %d: requesting forced "
+            # this fires once.  Keep it to one line — and keep it the
+            # ONLY non-flag operation in any handler (reviewed
+            # exception to the flag-only rule, hence the inline
+            # suppression rather than a baseline entry).
+            log.warning("received signal %d: requesting forced "  # eksml-lint: disable=signal-safety
                         "checkpoint at the next step boundary", signum)
 
     def install(self) -> "PreemptionHandler":

@@ -189,8 +189,11 @@ class HangWatchdog:
         from eksml_tpu.telemetry.tracing import format_thread_stacks
 
         lines.extend(format_thread_stacks().splitlines())
-        with open(path, "w") as f:
-            f.write("\n".join(lines) + "\n")
+        # atomic: an operator tails these the moment the watchdog
+        # fires — never show a half-written report
+        from eksml_tpu.fsio import atomic_write_text
+
+        atomic_write_text(path, "\n".join(lines) + "\n")
         return path
 
     @staticmethod

@@ -57,6 +57,7 @@ QUICK_SHAPES = ["--image-size", "128", "--batch-size", "1",
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 from eksml_tpu.config import SMOKE_OVERRIDES  # noqa: E402
+from eksml_tpu.fsio import atomic_write_json  # noqa: E402
 
 QUICK_CONFIG = list(SMOKE_OVERRIDES)
 
@@ -125,8 +126,7 @@ def main(argv=None):
     payload = {"sweep": results}
     print(json.dumps(payload))
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    with open(args.out, "w") as f:
-        json.dump(payload, f, indent=1)
+    atomic_write_json(args.out, payload)
 
 
 if __name__ == "__main__":

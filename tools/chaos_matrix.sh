@@ -14,6 +14,10 @@
 # mid-run /debugz/profile capture lands Chrome-trace span artifacts,
 # trace_summary --merge names dominant spans, losses stay
 # bit-identical with tracing on.
+# unit-lint runs eksml-lint (eksml_tpu/analysis/, ISSUE 8) over the
+# real tree via tests/test_lint.py — the framework-invariant static
+# gate (jit purity, post-override config drift, signal-handler
+# safety, atomic writes, scope coverage, chart/values sync).
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # processes and are marked slow (excluded from tier-1); the unit and
 # data-* rungs run in seconds.  Everything runs under
@@ -41,6 +45,7 @@ RUNGS=(
   "unit-tracing|tests/test_tracing.py tests/test_bench_gate.py"
   "unit-sharding|tests/test_sharding.py"
   "unit-perfgate|tests/test_perf_gate.py"
+  "unit-lint|tests/test_lint.py"
   "data-corrupt-jpeg|'tests/test_fault_tolerance.py::test_data_fault_rung[corrupt-jpeg]'"
   "data-missing-file|'tests/test_fault_tolerance.py::test_data_fault_rung[missing-file]'"
   "data-eio-recover|'tests/test_fault_tolerance.py::test_data_fault_rung[eio-recover]'"

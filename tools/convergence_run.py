@@ -104,10 +104,12 @@ def main(argv=None):
     # boundary far past the run but int32-safe after the ×8/batch
     # rescale (a 1e9 sentinel overflowed jit argument parsing)
     cfg.TRAIN.LR_SCHEDULE = (10 ** 6,)  # constant post-warmup
-    cfg.TRAIN.STEPS_PER_EPOCH = args.steps
+    flag_steps = args.steps
+    flag_log_period = max(1, min(10, flag_steps // 6))
+    cfg.TRAIN.STEPS_PER_EPOCH = flag_steps
     cfg.TRAIN.MAX_EPOCHS = 1
     cfg.TRAIN.CHECKPOINT_PERIOD = 1
-    cfg.TRAIN.LOG_PERIOD = max(1, min(10, args.steps // 6))
+    cfg.TRAIN.LOG_PERIOD = flag_log_period
     cfg.TRAIN.NUM_CHIPS = 1
     cfg.TPU.MESH_SHAPE = (1, 1)
     cfg.BACKBONE.WEIGHTS = ""
@@ -115,6 +117,22 @@ def main(argv=None):
     cfg.TRAIN.LOGDIR = logdir
     cfg.update_args(args.config)
     finalize_configs(is_training=True)
+    # cfg is the source of truth after update_args: a --config
+    # TRAIN.STEPS_PER_EPOCH override must change the run length too,
+    # not just the LR bookkeeping the copy above feeds
+    steps = int(cfg.TRAIN.STEPS_PER_EPOCH)
+    log_overridden = any(
+        o.split("=", 1)[0].strip() == "TRAIN.LOG_PERIOD"
+        for o in args.config)
+    if steps != flag_steps and not log_overridden:
+        # the logging cadence was derived from the flag above; follow
+        # the overridden run length UNLESS the operator overrode
+        # LOG_PERIOD itself (then their value wins — detected by key,
+        # not by value, so an explicit override that happens to equal
+        # the derived cadence still wins)
+        cfg.freeze(False)
+        cfg.TRAIN.LOG_PERIOD = max(1, min(10, steps // 6))
+        cfg.freeze()
 
     ds = CocoDataset(base, "train2017")
     records = ds.records()
@@ -124,7 +142,7 @@ def main(argv=None):
 
     trainer = Trainer(cfg, logdir)
     t0 = time.time()
-    state = trainer.fit(loader.batches(None), total_steps=args.steps)
+    state = trainer.fit(loader.batches(None), total_steps=steps)
     train_time = time.time() - t0
 
     # loss curve from the metric writer's JSONL
@@ -143,7 +161,7 @@ def main(argv=None):
     early = float(np.mean([c["total_loss"] for c in curve[:n]]))
     late = float(np.mean([c["total_loss"] for c in curve[-n:]]))
     summary = {
-        "steps": args.steps,
+        "steps": steps,
         "image_size": size,
         "batch_size": args.batch_size,
         "overrides": list(args.config),
@@ -166,9 +184,10 @@ def main(argv=None):
     out = json.dumps(summary)
     print(out)
     if args.out:
+        from eksml_tpu.fsio import atomic_write_text
+
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            f.write(out + "\n")
+        atomic_write_text(args.out, out + "\n")
 
     if not args.no_check:
         check_convergence(early, late, results.get("bbox/AP50", 0))

@@ -66,13 +66,18 @@ def main(argv=None):
     cfg.BACKBONE.WEIGHTS = ""
     cfg.update_args(args.config)
     finalize_configs(is_training=True)  # trainer state incl. optimizer
+    # cfg is the source of truth after update_args: a --config
+    # TRAIN.LOGDIR / DATA.BASEDIR override must move the checkpoint
+    # read and the dataset together, not leave them on the flags
+    logdir = cfg.TRAIN.LOGDIR
+    data_dir = cfg.DATA.BASEDIR
 
     # read-only: never append to the run's metrics.jsonl / TB events
-    trainer = Trainer(cfg, args.logdir, write_metrics=False)
+    trainer = Trainer(cfg, logdir, write_metrics=False)
     latest = trainer.ckpt.latest_step()
     if latest is None:
         print("eval_ckpt: no checkpoint found under "
-              f"{args.logdir}/checkpoints", file=sys.stderr)
+              f"{logdir}/checkpoints", file=sys.stderr)
         return 1
     at_step = latest if args.step is None else args.step
     example = make_synthetic_batch(cfg, batch_size=1,
@@ -87,11 +92,11 @@ def main(argv=None):
               f"{os.listdir(trainer.ckpt.directory)}", file=sys.stderr)
         return 1
 
-    records = CocoDataset(args.data, args.split).records(skip_empty=False)
+    records = CocoDataset(data_dir, args.split).records(skip_empty=False)
     t0 = time.time()
     results = run_evaluation(trainer.model, state.params, cfg, records,
                              max_images=args.max_images)
-    payload = {"logdir": args.logdir, "step": int(at_step),
+    payload = {"logdir": logdir, "step": int(at_step),
                "split": args.split,
                "num_images": (min(args.max_images, len(records))
                               if args.max_images else len(records)),
@@ -99,9 +104,10 @@ def main(argv=None):
                **{k: round(float(v), 4) for k, v in results.items()}}
     print(json.dumps(payload))
     if args.out:
+        from eksml_tpu.fsio import atomic_write_json
+
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            json.dump(payload, f, indent=1)
+        atomic_write_json(args.out, payload)
     return 0
 
 

@@ -32,10 +32,13 @@ def subset_split(src: str, dst: str, split: str, n: int) -> None:
     for im in images:
         shutil.copy2(os.path.join(src, split, im["file_name"]),
                      os.path.join(dst, split, im["file_name"]))
-    with open(os.path.join(dst, "annotations",
-                           f"instances_{split}.json"), "w") as f:
+    ann_path = os.path.join(dst, "annotations",
+                            f"instances_{split}.json")
+    tmp = ann_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"images": images, "annotations": anns,
                    "categories": data["categories"]}, f)
+    os.replace(tmp, ann_path)
     print(f"{split}: {len(images)} images, {len(anns)} annotations")
 
 

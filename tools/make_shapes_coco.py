@@ -109,10 +109,13 @@ def make_split(dst: str, split: str, n_img: int, size: int, seed: int,
         Image.fromarray(img).save(
             os.path.join(dst, split, f"{split}_{i:04d}.jpg"), quality=92)
     os.makedirs(os.path.join(dst, "annotations"), exist_ok=True)
-    with open(os.path.join(dst, "annotations",
-                           f"instances_{split}.json"), "w") as f:
+    ann_path = os.path.join(dst, "annotations",
+                            f"instances_{split}.json")
+    tmp = ann_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"images": images, "annotations": anns,
                    "categories": CATEGORIES}, f)
+    os.replace(tmp, ann_path)
 
 
 def main(argv=None):

@@ -57,6 +57,8 @@ from typing import Any, Dict, List, Optional
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from eksml_tpu.fsio import atomic_write_json, atomic_write_text  # noqa: E402
+
 # Rung geometries the predictor lowers (canvas × batch, plus the knobs
 # a rung pre-plans — mirrors bench.py RUNGS where the names overlap so
 # a measured rung pairs with its prediction by name).
@@ -290,15 +292,15 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 if args.fresh_dir:
                     os.makedirs(args.fresh_dir, exist_ok=True)
-                    with open(os.path.join(
-                            args.fresh_dir,
-                            f"perf_pred_{key}.json"), "w") as f:
-                        json.dump(fresh, f, indent=1)
+                    # atomic: bench_gate --predicted may poll this
+                    # dir while we lower the next rung
+                    atomic_write_json(os.path.join(
+                        args.fresh_dir, f"perf_pred_{key}.json"),
+                        fresh)
                 if args.update_baseline:
                     os.makedirs(args.bank_dir, exist_ok=True)
                     path = baseline_path(args.bank_dir, key)
-                    with open(path, "w") as f:
-                        json.dump(fresh, f, indent=1)
+                    atomic_write_json(path, fresh)
                     verdict["results"].append({
                         "key": key, "gate": "BANKED",
                         "predicted_step_time_ms":
@@ -325,8 +327,7 @@ def main(argv=None) -> int:
     payload = json.dumps(verdict, indent=1)
     print(payload)
     if args.out:
-        with open(args.out, "w") as f:
-            f.write(payload)
+        atomic_write_text(args.out, payload)
     return 0 if ok else 1
 
 

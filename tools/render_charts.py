@@ -462,8 +462,11 @@ def main(argv=None):
     if args.update:
         os.makedirs(golden_abs, exist_ok=True)
         for name, text in sorted(rendered.items()):
-            with open(os.path.join(golden_abs, name), "w") as f:
+            dest = os.path.join(golden_abs, name)
+            tmp = dest + ".tmp"
+            with open(tmp, "w") as f:
                 f.write(text)
+            os.replace(tmp, dest)
             print(f"wrote {GOLDEN_DIR}/{name}")
         return 0
     rc = 0

@@ -387,8 +387,10 @@ def main(argv=None) -> int:
                            max_events=args.max_events,
                            artifacts_dir=args.artifacts)
     if args.out:
-        with open(args.out, "w") as f:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
             f.write(report)
+        os.replace(tmp, args.out)
         print(f"wrote {args.out}")
     else:
         sys.stdout.write(report)

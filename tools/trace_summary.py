@@ -362,8 +362,10 @@ def main(argv=None):
         print(json.dumps(summary, indent=1))
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
             f.write(json.dumps(summary, indent=1) + "\n")
+        os.replace(tmp, args.out)
     return 0
 
 
