@@ -21,9 +21,32 @@ without reviewer memory:
 - ``values-config-sync``— chart values keys render into ``--config``
   keys that exist in config.py, and no values key goes dead
 
-Entry point: ``tools/eksml_lint.py`` (JSON + human output, committed
-baseline, ``# eksml-lint: disable=<rule>`` suppressions, nonzero exit
-on any non-baselined finding — a tier-1 gate via tests/test_lint.py).
+v2 (ISSUE 9) adds a whole-program cross-module call graph
+(:mod:`.graph`: import-alias resolution, ``__init__.py`` re-exports,
+relative imports, chain-recording reachability) — ``jit-purity`` and
+``signal-safety`` now see through imports (the v1 escape hatch) — and
+four SPMD-safety rules (:mod:`.spmd`) encoding the invariants whose
+violations the runtime layers can only diagnose post-mortem:
+
+- ``collective-order``  — no collective reachable only under a
+  ``jax.process_index()``/host-rank conditional, inside an exception
+  handler, or after a host-divergent early exit (the distributed-hang
+  class the watchdog reports after the fact)
+- ``rng-discipline``    — the zero-RNG contract set (loader quarantine
+  substitution, span tracing, telemetry aggregation) reaches no host
+  RNG draw through any call chain
+- ``host-sync``         — per-step device syncs on the hot loop
+  (``Trainer.fit``, ``DevicePrefetcher``); the legal log-step/capture
+  sites carry justified inline suppressions
+- ``recompile-hazard``  — batch-content Python scalars (``len``,
+  ``.shape[i]``, per-batch dict keys) must not feed jitted callables
+  outside the bucketed static-shape schedule
+
+Entry point: ``tools/eksml_lint.py`` (JSON + human output — findings
+carry the root→collective ``chain`` — committed baseline,
+``# eksml-lint: disable=<rule>`` suppressions, ``--changed`` fast
+pre-commit scope, nonzero exit on any non-baselined finding — a
+tier-1 gate via tests/test_lint.py + tests/test_lint_spmd.py).
 """
 
 from eksml_tpu.analysis.engine import (  # noqa: F401

@@ -7,10 +7,14 @@ override point, ``plan.jit``/``jax.jit`` as the trace boundary,
 ``signal.signal`` registration, the write-then-``os.replace`` artifact
 idiom, and the ``jax.named_scope`` ↔ ``SCOPE_RULES`` contract.
 
-Static-analysis scope: call graphs resolve within one module (plain
-``f()`` calls and ``self.m()``/``cls.m()`` methods).  Cross-module
-reachability is out of scope — the invariants live where the pattern
-and its hazard share a file, which is everywhere they have bitten.
+Static-analysis scope (v2, ISSUE 9): ``jit-purity`` and
+``signal-safety`` run on the WHOLE-PROGRAM cross-module call graph
+(:mod:`eksml_tpu.analysis.graph` — import-alias resolution,
+``__init__.py`` re-exports, relative imports), closing PR 8's
+documented escape hatch of an impure helper one import away.  The four
+SPMD-safety rules (:mod:`eksml_tpu.analysis.spmd`) ride the same
+graph.  The remaining rules stay per-module/per-project where the
+pattern and its hazard share a file.
 """
 
 from __future__ import annotations
@@ -21,6 +25,10 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from eksml_tpu.analysis.engine import Finding, ModuleInfo
+from eksml_tpu.analysis.graph import (FuncInfo, ProjectGraph,
+                                      chain_of as _chain,
+                                      unparse as _unparse)
+from eksml_tpu.analysis.spmd import SPMD_RULES, build_spmd_checkers
 
 RULE_JIT = "jit-purity"
 RULE_DRIFT = "config-drift"
@@ -30,70 +38,7 @@ RULE_SCOPE = "scope-coverage"
 RULE_VALUES = "values-config-sync"
 
 ALL_RULES = (RULE_JIT, RULE_DRIFT, RULE_SIGNAL, RULE_ATOMIC,
-             RULE_SCOPE, RULE_VALUES)
-
-
-# -- shared AST helpers ----------------------------------------------
-
-def _chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
-    """``a.b.c`` → ("a", "b", "c"); None when the root isn't a Name."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return None
-
-
-def _unparse(node: ast.AST) -> str:
-    try:
-        return ast.unparse(node)
-    except Exception:  # noqa: BLE001 — diagnostics only
-        return "<expr>"
-
-
-class _CallGraph:
-    """Intra-module call graph over bare function names.
-
-    Resolves ``f()`` and ``self.m()``/``cls.m()`` calls to any
-    same-named def in the module (an over-approximation that errs
-    toward checking more code, never less).
-    """
-
-    def __init__(self, tree: ast.AST):
-        self.defs: Dict[str, List[ast.FunctionDef]] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.defs.setdefault(node.name, []).append(node)
-
-    @staticmethod
-    def _callees(func: ast.AST) -> set:
-        out = set()
-        for node in ast.walk(func):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if isinstance(f, ast.Name):
-                out.add(f.id)
-            elif (isinstance(f, ast.Attribute)
-                  and isinstance(f.value, ast.Name)
-                  and f.value.id in ("self", "cls")):
-                out.add(f.attr)
-        return out
-
-    def reachable(self, roots: Iterable[ast.AST]) -> List[ast.AST]:
-        seen_ids, order, stack = set(), [], list(roots)
-        while stack:
-            fn = stack.pop()
-            if id(fn) in seen_ids:
-                continue
-            seen_ids.add(id(fn))
-            order.append(fn)
-            for name in self._callees(fn):
-                stack.extend(self.defs.get(name, ()))
-        return order
+             RULE_SCOPE, RULE_VALUES) + SPMD_RULES
 
 
 # -- 1. jit-purity ----------------------------------------------------
@@ -117,26 +62,37 @@ class JitPurityChecker:
     I/O inside a traced function runs ONCE at trace time: the value is
     baked into the compiled program (non-determinism across compiles,
     cache-key poisoning) and the side effect silently never recurs.
+
+    v2: reachability runs on the cross-module graph — an impure helper
+    imported from another module (PR 8's documented escape hatch) is
+    now inside the checked set.  Impurity CLASSIFICATION resolves
+    import aliases through :meth:`ProjectGraph.canonical`, so
+    ``import numpy.random as nr`` cannot hide a draw; messages keep
+    the raw source spelling.
     """
 
     rule = RULE_JIT
 
-    def check(self, mod: ModuleInfo) -> List[Finding]:
-        graph = _CallGraph(mod.tree)
-        roots: List[Tuple[str, ast.AST]] = []
-        for node in ast.walk(mod.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if self._decorator_is_jit(dec):
-                        roots.append((node.name, node))
-            elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
-                roots.extend(self._call_roots(node, graph))
+    def check_graph(self, graph: ProjectGraph) -> List[Finding]:
         findings: List[Finding] = []
-        reported: set = set()  # node ids — two roots reaching the
-        for root_name, root in roots:  # same helper report it once
-            for fn in graph.reachable([root]):
-                findings.extend(self._scan(mod, fn, root_name,
-                                           reported))
+        reported: set = set()  # (node id, what) — two roots reaching
+        for path, mod in graph.mods.items():  # one helper → one report
+            roots: List[Tuple[str, FuncInfo]] = []
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if self._decorator_is_jit(dec):
+                            fi = graph.func_for_node(node)
+                            if fi is not None:
+                                roots.append((node.name, fi))
+                elif isinstance(node, ast.Call) \
+                        and _is_jit_expr(node.func):
+                    roots.extend(self._call_roots(graph, path, node))
+            for root_name, root in roots:
+                for fi, _chain_to in graph.reachable([root]).values():
+                    findings.extend(self._scan(graph, fi, root_name,
+                                               reported))
         return findings
 
     @staticmethod
@@ -153,13 +109,13 @@ class JitPurityChecker:
         return False
 
     @staticmethod
-    def _call_roots(node: ast.Call, graph: _CallGraph
-                    ) -> List[Tuple[str, ast.AST]]:
+    def _call_roots(graph: ProjectGraph, path: str, node: ast.Call
+                    ) -> List[Tuple[str, FuncInfo]]:
         if not node.args:
             return []
         target = node.args[0]
         if isinstance(target, ast.Lambda):
-            return [("<lambda>", target)]
+            return [("<lambda>", FuncInfo(path, "<lambda>", target))]
         name = None
         if isinstance(target, ast.Name):
             name = target.id
@@ -167,14 +123,16 @@ class JitPurityChecker:
             name = target.attr      # plan.jit(self._train_step, ...)
         if name is None:
             return []
-        return [(name, fn) for fn in graph.defs.get(name, ())]
+        return [(name, fi)
+                for fi in graph.resolve_name_ref(path, name)]
 
-    def _scan(self, mod: ModuleInfo, fn: ast.AST, root: str,
+    def _scan(self, graph: ProjectGraph, fi: FuncInfo, root: str,
               reported: set) -> List[Finding]:
-        out = []
+        out: List[Finding] = []
+        mod = graph.mods.get(fi.path)
 
         def flag(node: ast.AST, what: str) -> None:
-            if (id(node), what) in reported:
+            if (id(node), what) in reported or mod is None:
                 return
             reported.add((id(node), what))
             out.append(mod.finding(
@@ -184,29 +142,33 @@ class JitPurityChecker:
                 "hoist to the host side or use jax.random/"
                 "jax.debug.*"))
 
-        for node in ast.walk(fn):
+        for node in ast.walk(fi.node):
             if isinstance(node, ast.Call):
                 c = _chain(node.func)
                 if c is None:
                     continue
-                if c[0] == "time" and len(c) == 2:
-                    flag(node, f"wall-clock read {'.'.join(c)}()")
-                elif c[0] in ("np", "numpy") and len(c) >= 2 \
-                        and c[1] == "random":
-                    flag(node, f"host RNG {'.'.join(c)}()")
-                elif c[0] == "random" and len(c) == 2:
-                    flag(node, f"host RNG {'.'.join(c)}()")
-                elif c[:2] == ("os", "environ") and len(c) == 3 \
-                        and c[2] in _ENV_MUTATORS:
-                    flag(node, f"os.environ mutation .{c[2]}()")
-                elif c == ("os", "putenv") or c == ("os", "unsetenv"):
-                    flag(node, f"{'.'.join(c)}() env mutation")
-                elif c[0] == "os" and len(c) == 2 and c[1] in _OS_IO:
-                    flag(node, f"host I/O {'.'.join(c)}()")
-                elif c[0] == "shutil":
-                    flag(node, f"host I/O {'.'.join(c)}()")
-                elif c == ("open",) or c == ("print",):
-                    flag(node, f"host I/O {c[0]}()")
+                disp = ".".join(c)
+                canon = graph.canonical(fi.path, node.func) or disp
+                cc = tuple(canon.split("."))
+                if cc[0] == "time" and len(cc) == 2:
+                    flag(node, f"wall-clock read {disp}()")
+                elif cc[0] in ("np", "numpy") and len(cc) >= 2 \
+                        and cc[1] == "random":
+                    flag(node, f"host RNG {disp}()")
+                elif cc[0] == "random" and len(cc) == 2:
+                    flag(node, f"host RNG {disp}()")
+                elif cc[:2] == ("os", "environ") and len(cc) == 3 \
+                        and cc[2] in _ENV_MUTATORS:
+                    flag(node, f"os.environ mutation .{cc[2]}()")
+                elif cc in (("os", "putenv"), ("os", "unsetenv")):
+                    flag(node, f"{disp}() env mutation")
+                elif cc[0] == "os" and len(cc) == 2 \
+                        and cc[1] in _OS_IO:
+                    flag(node, f"host I/O {disp}()")
+                elif cc[0] == "shutil":
+                    flag(node, f"host I/O {disp}()")
+                elif cc in (("open",), ("print",)):
+                    flag(node, f"host I/O {cc[0]}()")
             elif isinstance(node, (ast.Assign, ast.AugAssign,
                                    ast.Delete)):
                 targets = (node.targets
@@ -326,39 +288,46 @@ class SignalSafetyChecker:
 
     rule = RULE_SIGNAL
 
-    def check(self, mod: ModuleInfo) -> List[Finding]:
-        graph = _CallGraph(mod.tree)
+    def check_graph(self, graph: ProjectGraph) -> List[Finding]:
         findings: List[Finding] = []
         reported: set = set()  # node ids — one handler registered for
-        for node in ast.walk(mod.tree):  # N signals reports once
-            if not (isinstance(node, ast.Call)
-                    and _chain(node.func) == ("signal", "signal")
-                    and len(node.args) >= 2):
-                continue
-            handler = node.args[1]
-            roots: List[ast.AST] = []
-            if isinstance(handler, ast.Lambda):
-                roots = [handler]
-            else:
-                name = None
-                if isinstance(handler, ast.Name):
-                    name = handler.id
-                elif isinstance(handler, ast.Attribute):
-                    name = handler.attr
-                if name is not None:
-                    roots = list(graph.defs.get(name, ()))
-                # unresolved (restoring a saved previous handler,
-                # signal.SIG_DFL/SIG_IGN) — nothing to check
-            for root in roots:
-                root_name = getattr(root, "name", "<lambda>")
-                for fn in graph.reachable([root]):
-                    findings.extend(self._scan(mod, fn, root_name,
-                                               reported))
+        for path, mod in graph.mods.items():  # N signals reports once
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and _chain(node.func) == ("signal", "signal")
+                        and len(node.args) >= 2):
+                    continue
+                handler = node.args[1]
+                roots: List[FuncInfo] = []
+                if isinstance(handler, ast.Lambda):
+                    roots = [FuncInfo(path, "<lambda>", handler)]
+                else:
+                    name = None
+                    if isinstance(handler, ast.Name):
+                        name = handler.id
+                    elif isinstance(handler, ast.Attribute):
+                        name = handler.attr
+                    if name is not None:
+                        roots = graph.resolve_name_ref(path, name)
+                    # unresolved (restoring a saved previous handler,
+                    # signal.SIG_DFL/SIG_IGN) — nothing to check
+                for root in roots:
+                    root_name = root.name
+                    # cross-module walk: a handler calling an imported
+                    # publish helper is checked through the import
+                    for fi, _c in graph.reachable([root]).values():
+                        findings.extend(self._scan(graph, fi,
+                                                   root_name,
+                                                   reported))
         return findings
 
-    def _scan(self, mod: ModuleInfo, fn: ast.AST, root: str,
+    def _scan(self, graph: ProjectGraph, fi: FuncInfo, root: str,
               reported: set) -> List[Finding]:
-        out = []
+        out: List[Finding] = []
+        mod = graph.mods.get(fi.path)
+        if mod is None:
+            return out
+        fn = fi.node
 
         def flag(node: ast.AST, what: str) -> None:
             if (id(node), what) in reported:
@@ -769,9 +738,14 @@ class ValuesConfigSyncChecker:
 # -- registry ---------------------------------------------------------
 
 def build_checkers(rules: Optional[Sequence[str]] = None):
-    """(module_checkers, project_checkers) filtered by rule name."""
-    module_checkers = [JitPurityChecker(), ConfigDriftChecker(),
-                       SignalSafetyChecker(), AtomicWriteChecker()]
+    """(module_checkers, graph_checkers, project_checkers) filtered by
+    rule name.  Graph checkers run on one shared
+    :class:`~eksml_tpu.analysis.graph.ProjectGraph` built by the
+    engine: jit-purity and signal-safety (rebased in v2) plus the four
+    SPMD rules."""
+    module_checkers = [ConfigDriftChecker(), AtomicWriteChecker()]
+    graph_checkers = [JitPurityChecker(), SignalSafetyChecker()]
+    graph_checkers += build_spmd_checkers()
     project_checkers = [ScopeCoverageChecker(),
                         ValuesConfigSyncChecker()]
     if rules is not None:
@@ -783,6 +757,8 @@ def build_checkers(rules: Optional[Sequence[str]] = None):
                 f"known: {list(ALL_RULES)}")
         module_checkers = [c for c in module_checkers
                            if c.rule in wanted]
+        graph_checkers = [c for c in graph_checkers
+                          if c.rule in wanted]
         project_checkers = [c for c in project_checkers
                             if c.rule in wanted]
-    return module_checkers, project_checkers
+    return module_checkers, graph_checkers, project_checkers

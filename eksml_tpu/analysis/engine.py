@@ -36,24 +36,34 @@ _SUPPRESS_RE = re.compile(r"#\s*eksml-lint:\s*disable=([\w\-,]+)")
 class Finding:
     """One lint result, line-number independent for baselining."""
 
-    __slots__ = ("rule", "path", "line", "message", "severity", "context")
+    __slots__ = ("rule", "path", "line", "message", "severity",
+                 "context", "chain")
 
     def __init__(self, rule: str, path: str, line: int, message: str,
-                 severity: str = "error", context: str = ""):
+                 severity: str = "error", context: str = "",
+                 chain: Optional[List[dict]] = None):
         self.rule = rule
         self.path = path          # repo-relative, "/"-separated
         self.line = line          # 1-based
         self.message = message
         self.severity = severity
         self.context = context    # stripped source line at `line`
+        # call chain root → sink for the cross-module rules:
+        # [{"path":…, "line":…, "name":…}, …] — rendered into --json so
+        # run_report.py can cross-link a watchdog hang report to the
+        # matching static finding.  Not part of the baseline key.
+        self.chain = chain or None
 
     def key(self) -> Tuple[str, str, str]:
         return (self.rule, self.path, self.context)
 
     def to_dict(self) -> dict:
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "severity": self.severity, "message": self.message,
-                "context": self.context}
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "severity": self.severity, "message": self.message,
+             "context": self.context}
+        if self.chain:
+            d["chain"] = list(self.chain)
+        return d
 
     def __repr__(self) -> str:  # debugging/pytest output
         return (f"{self.path}:{self.line}: {self.rule}: "
@@ -170,11 +180,16 @@ def run_lint(targets: Optional[Sequence[str]] = None,
              repo_root: Optional[str] = None,
              rules: Optional[Sequence[str]] = None,
              baseline: Optional[Iterable[Tuple[str, str, str]]] = None,
+             only_paths: Optional[Iterable[str]] = None,
              ) -> LintResult:
     """Run the checkers over *targets* (default: the production tree).
 
     ``rules`` filters by rule name (fixture tests isolate one checker);
     ``baseline`` is a set of grandfathered :meth:`Finding.key` tuples.
+    ``only_paths`` (the ``--changed`` fast path) reports findings only
+    for those repo-relative paths — the cross-module graph is still
+    built over the full target set, so a changed caller is checked
+    against its unchanged callees.
     """
     from eksml_tpu.analysis.checkers import build_checkers
 
@@ -190,10 +205,17 @@ def run_lint(targets: Optional[Sequence[str]] = None,
                            "mistyped path? (an empty scope must not "
                            "pass the gate)", context=t))
 
-    module_checkers, project_checkers = build_checkers(rules)
+    module_checkers, graph_checkers, project_checkers = \
+        build_checkers(rules)
     for mod in mods.values():
         for checker in module_checkers:
             raw.extend(checker.check(mod))
+    if graph_checkers:
+        from eksml_tpu.analysis.graph import ProjectGraph
+
+        graph = ProjectGraph(mods)
+        for checker in graph_checkers:
+            raw.extend(checker.check_graph(graph))
     for checker in project_checkers:
         raw.extend(checker.check_project(mods, repo_root))
 
@@ -221,6 +243,11 @@ def run_lint(targets: Optional[Sequence[str]] = None,
             baselined.append(f)
             continue
         findings.append(f)
+    if only_paths is not None:
+        keep = set(only_paths)
+        findings = [f for f in findings if f.path in keep]
+        suppressed = [f for f in suppressed if f.path in keep]
+        baselined = [f for f in baselined if f.path in keep]
     return LintResult(findings, suppressed, baselined,
                       [m.path for m in mods.values()])
 

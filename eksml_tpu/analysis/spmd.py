@@ -1,0 +1,656 @@
+"""The four SPMD-safety checkers (eksml-lint v2, ISSUE 9).
+
+Each encodes a cross-host invariant of synchronous SPMD training whose
+violation the runtime layers can only diagnose AFTER the fact (the
+hang watchdog reports a wedged collective post-mortem; the
+bit-identity pins catch RNG drift only when a test runs both sides):
+
+- ``collective-order``  — a collective every host must enter together
+  (``multihost_utils.*``, the repo's collective entry points, Orbax
+  barrier waits) must not be reachable only under a host-divergent
+  conditional (``jax.process_index()``/host-rank), inside an
+  ``except`` handler (exceptions fire on the raising host only), or
+  after a host-divergent early ``return``/``raise``.  The static form
+  of the distributed-hang class.
+- ``rng-discipline``    — the zero-RNG contract set (loader quarantine
+  substitution, span tracing, telemetry aggregation) must not reach a
+  host RNG draw through ANY call chain: one draw on one host shifts
+  that host's stream and the cross-host batch schedule / bit-identical
+  loss pins break.
+- ``host-sync``         — device syncs (``.item()``, ``np.asarray``,
+  ``jax.device_get``, ``block_until_ready``) reachable from the hot
+  step path (``Trainer.fit``, ``DevicePrefetcher``) stall the step
+  loop once per step; the known-legal sites (loss materialization at
+  log steps, profiler capture boundaries) carry inline suppressions
+  with justifications.
+- ``recompile-hazard``  — batch-content Python scalars (``len(...)``,
+  ``.shape[i]``, per-batch dict keys) fed to a jitted callable key the
+  compile cache per VALUE; shapes must route through the bucketed
+  static-shape schedule (``PREPROC.BUCKETS`` → loader
+  ``assign_bucket``) — the contract the serving path inherits.
+
+All four run on the cross-module graph (:mod:`.graph`), so the
+divergent/impure call can live any number of imports away; findings
+carry the ``path:line`` call chain root → sink (``--json`` exposes it
+as ``chain`` so run_report.py can cross-link a watchdog hang report).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from eksml_tpu.analysis.engine import Finding, ModuleInfo
+from eksml_tpu.analysis.graph import (ChainEntry, FuncInfo, ProjectGraph,
+                                      chain_dicts, chain_of,
+                                      format_chain, iter_scope,
+                                      scope_parents, unparse)
+
+RULE_COLLECTIVE = "collective-order"
+RULE_RNG = "rng-discipline"
+RULE_SYNC = "host-sync"
+RULE_RECOMPILE = "recompile-hazard"
+
+SPMD_RULES = (RULE_COLLECTIVE, RULE_RNG, RULE_SYNC, RULE_RECOMPILE)
+
+
+def _finding(mod_lookup: Dict[str, ModuleInfo], rule: str, path: str,
+             line: int, message: str,
+             chain: Optional[List[ChainEntry]] = None) -> Finding:
+    mod = mod_lookup.get(path)
+    ctx = mod.line_text(line) if mod is not None else ""
+    return Finding(rule, path, line, message, context=ctx,
+                   chain=chain_dicts(chain) if chain else None)
+
+
+def _paths_matching(graph: ProjectGraph, contract: str) -> List[str]:
+    """Linted paths matching a contract path — suffix-tolerant so a
+    probe copy of a contract module linted from another root (the
+    acceptance injections, fixture packages) still engages the rule."""
+    return [p for p in graph.mods
+            if p == contract or p.endswith("/" + contract)]
+
+
+# -- 1. collective-order ----------------------------------------------
+
+#: Host-level collective primitives by canonical/raw dotted prefix.
+_COLLECTIVE_PREFIXES = ("jax.experimental.multihost_utils.",
+                       "multihost_utils.")
+#: Barrier spellings matched by bare method name (the Orbax async-
+#: commit barrier reached through an opaque manager attribute, and
+#: the coordination-service barrier the runtime hang pin drives).
+_BARRIER_ATTRS = ("wait_until_finished", "sync_global_devices",
+                  "wait_at_barrier")
+#: Repo entry points whose collective is not pattern-visible (a jitted
+#: global computation / shard_map / multi-host Orbax save-restore).
+_SEED_COLLECTIVE_DEFS = (
+    ("eksml_tpu/parallel/collectives.py", "warm_mesh_collectives"),
+    ("eksml_tpu/parallel/collectives.py", "assert_replicas_in_sync"),
+    ("eksml_tpu/utils/checkpoint.py", "CheckpointManager.save"),
+    ("eksml_tpu/utils/checkpoint.py", "CheckpointManager.restore"),
+)
+#: Calls whose result differs per host (the repo's own wrappers too).
+_DIVERGENT_CALLS = ("process_index", "is_coordinator")
+#: Names that mean "this host's rank" wherever they appear.
+_DIVERGENT_NAMES = ("host_id", "host_rank", "rank_id")
+
+
+class CollectiveOrderChecker:
+    """No collective behind a host-divergent branch — statically.
+
+    The watchdog diagnoses the resulting hang post-mortem (one host
+    waits in the collective forever, the rest have moved on or
+    exited); this is the same bug at review time.  Uniform predicates
+    (``process_count()``, step counters, config reads) never flag —
+    divergence requires a host-RANK marker.  Exception handlers count
+    as divergent per se: an exception is a host-local event, so a
+    collective (or a ``return``/``raise`` before one) inside a
+    handler splits the fleet.
+    """
+
+    rule = RULE_COLLECTIVE
+
+    def check_graph(self, graph: ProjectGraph) -> List[Finding]:
+        chains = self._collective_chains(graph)
+        findings: List[Finding] = []
+        reported: Set[Tuple[int, str]] = set()
+        for scope in graph.scopes():
+            findings.extend(self._check_scope(graph, scope, chains,
+                                              reported))
+        return findings
+
+    # -- collective discovery -----------------------------------------
+
+    def _primitive_label(self, graph: ProjectGraph, path: str,
+                         call: ast.Call) -> Optional[str]:
+        c = chain_of(call.func)
+        canon = graph.canonical(path, call.func)
+        for cand in filter(None, (canon, ".".join(c) if c else None)):
+            for prefix in _COLLECTIVE_PREFIXES:
+                if cand.startswith(prefix):
+                    return cand.rsplit(".", 1)[-1]
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _BARRIER_ATTRS):
+            return call.func.attr
+        return None
+
+    def _collective_chains(self, graph: ProjectGraph
+                           ) -> Dict[int, List[ChainEntry]]:
+        """{id(func node): call chain func → primitive} for every
+        function that (transitively) executes a collective."""
+        chains: Dict[int, List[ChainEntry]] = {}
+        for fi in graph.functions:
+            sites = []
+            for n in ast.walk(fi.node):
+                if isinstance(n, ast.Call):
+                    label = self._primitive_label(graph, fi.path, n)
+                    if label is not None:
+                        sites.append((n.lineno, label))
+            if sites:
+                line, label = min(sites)
+                chains[id(fi.node)] = [(fi.path, line, label)]
+        for seed_path, qual in _SEED_COLLECTIVE_DEFS:
+            for path in _paths_matching(graph, seed_path):
+                fi = graph.lookup(path, qual)
+                if fi is not None and id(fi.node) not in chains:
+                    chains[id(fi.node)] = [(path, fi.node.lineno,
+                                            f"{qual} (collective)")]
+        # reverse closure: callers of collective-reaching functions
+        changed = True
+        while changed:
+            changed = False
+            for fi in graph.functions:
+                if id(fi.node) in chains:
+                    continue
+                for call, callee in graph.calls_from(
+                        fi, unique_fallback=True):
+                    sub = chains.get(id(callee.node))
+                    if sub is not None:
+                        chains[id(fi.node)] = [
+                            (fi.path, call.lineno, callee.qualname)
+                        ] + sub
+                        changed = True
+                        break
+        return chains
+
+    # -- per-scope context checks -------------------------------------
+
+    @staticmethod
+    def _local_divergent_names(scope: FuncInfo) -> Set[str]:
+        """Names assigned from a host-rank expression in this scope
+        (``pid = jax.process_index()``) become divergence markers."""
+        out: Set[str] = set()
+        for n in iter_scope(scope.node):
+            if isinstance(n, ast.Assign):
+                divergent = False
+                for sub in ast.walk(n.value):
+                    if isinstance(sub, ast.Call):
+                        c = chain_of(sub.func)
+                        if c and c[-1] in _DIVERGENT_CALLS:
+                            divergent = True
+                    elif (isinstance(sub, ast.Name)
+                          and sub.id in _DIVERGENT_NAMES):
+                        divergent = True
+                if divergent:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    @staticmethod
+    def _divergent_marker(test: ast.AST,
+                          local_names: Set[str]) -> Optional[str]:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call):
+                c = chain_of(n.func)
+                if c and c[-1] in _DIVERGENT_CALLS:
+                    return ".".join(c) + "()"
+            elif isinstance(n, ast.Name) and (n.id in _DIVERGENT_NAMES
+                                              or n.id in local_names):
+                return n.id
+            elif (isinstance(n, ast.Attribute)
+                  and n.attr in _DIVERGENT_NAMES):
+                return n.attr
+        return None
+
+    def _ancestor_context(self, node: ast.AST, parents, local_names
+                          ) -> Tuple[Optional[ast.If], Optional[str],
+                                     Optional[ast.ExceptHandler]]:
+        """(divergent If ancestor, its marker, ExceptHandler ancestor)
+        — only body/orelse membership counts for the If (sitting in
+        the TEST of a rank conditional is how uniform code inspects
+        rank, not divergence)."""
+        guard = marker = handler = None
+        cur = node
+        while id(cur) in parents:
+            parent, field = parents[id(cur)]
+            if (isinstance(parent, ast.If) and field in ("body",
+                                                         "orelse")
+                    and guard is None):
+                m = self._divergent_marker(parent.test, local_names)
+                if m is not None:
+                    guard, marker = parent, m
+            elif isinstance(parent, ast.IfExp) and guard is None:
+                m = self._divergent_marker(parent.test, local_names)
+                if m is not None and field in ("body", "orelse"):
+                    guard, marker = parent, m
+            elif (isinstance(parent, ast.ExceptHandler)
+                  and handler is None):
+                handler = parent
+            cur = parent
+        return guard, marker, handler
+
+    def _check_scope(self, graph: ProjectGraph, scope: FuncInfo,
+                     chains: Dict[int, List[ChainEntry]],
+                     reported: Set[Tuple[int, str]]) -> List[Finding]:
+        # collective call sites lexically in this scope
+        sites: List[Tuple[ast.Call, List[ChainEntry]]] = []
+        for n in iter_scope(scope.node):
+            if not isinstance(n, ast.Call):
+                continue
+            label = self._primitive_label(graph, scope.path, n)
+            if label is not None:
+                sites.append((n, [(scope.path, n.lineno, label)]))
+                continue
+            for callee in graph.resolve_call(scope.path, n,
+                                             cls=scope.cls,
+                                             unique_fallback=True,
+                                             scope=scope):
+                sub = chains.get(id(callee.node))
+                if sub is not None:
+                    sites.append((n, [(scope.path, n.lineno,
+                                       callee.qualname)] + sub))
+                    break
+        if not sites:
+            return []
+
+        mods = graph.mods
+        parents = scope_parents(scope.node)
+        local_names = self._local_divergent_names(scope)
+        out: List[Finding] = []
+        for call, chain in sites:
+            sink = chain[-1][2]
+            guard, marker, handler = self._ancestor_context(
+                call, parents, local_names)
+            if guard is not None:
+                key = (id(call), "guard")
+                if key not in reported:
+                    reported.add(key)
+                    out.append(_finding(
+                        mods, self.rule, scope.path, call.lineno,
+                        f"collective '{sink}' is reachable only on "
+                        f"hosts passing the host-divergent guard at "
+                        f"{scope.path}:{guard.lineno} ({marker!r}) — "
+                        "the other hosts skip it and the fleet "
+                        "deadlocks in the collective (the hang class "
+                        "the watchdog can only report post-mortem); "
+                        "run it unconditionally or gate on a host-"
+                        "uniform predicate (process_count, step "
+                        "counters, config). "
+                        f"chain: {format_chain(chain)}",
+                        chain=chain))
+            elif handler is not None:
+                key = (id(call), "except")
+                if key not in reported:
+                    reported.add(key)
+                    out.append(_finding(
+                        mods, self.rule, scope.path, call.lineno,
+                        f"collective '{sink}' inside the exception "
+                        f"handler at {scope.path}:{handler.lineno} — "
+                        "exceptions are host-local events, so only "
+                        "the raising host enters the collective and "
+                        "the fleet deadlocks; record the error and "
+                        "agree on it collectively outside the handler "
+                        "(the checkpoint walk-back's _agreed_ok "
+                        "pattern). "
+                        f"chain: {format_chain(chain)}",
+                        chain=chain))
+        # host-divergent early exits BEFORE a collective in this scope
+        for n in iter_scope(scope.node):
+            if not isinstance(n, (ast.Return, ast.Raise)):
+                continue
+            later = [(c, ch) for c, ch in sites if c.lineno > n.lineno]
+            if not later:
+                continue
+            call, chain = min(later, key=lambda s: s[0].lineno)
+            guard, marker, handler = self._ancestor_context(
+                n, parents, local_names)
+            reason = None
+            if guard is not None:
+                reason = (f"host-divergent guard at {scope.path}:"
+                          f"{guard.lineno} ({marker!r})")
+            elif handler is not None:
+                reason = (f"exception handler at {scope.path}:"
+                          f"{handler.lineno} (a host-local event)")
+            if reason is None:
+                continue
+            kind = ("return" if isinstance(n, ast.Return) else "raise")
+            key = (id(n), "early-exit")
+            if key in reported:
+                continue
+            reported.add(key)
+            out.append(_finding(
+                mods, self.rule, scope.path, n.lineno,
+                f"early {kind} under the {reason} exits before the "
+                f"collective '{chain[-1][2]}' at {scope.path}:"
+                f"{call.lineno} — hosts taking this path skip the "
+                "collective while the rest block in it forever; "
+                "make the exit host-uniform or move it after the "
+                "collective. "
+                f"chain: {format_chain(chain)}",
+                chain=chain))
+        return out
+
+
+# -- 2. rng-discipline ------------------------------------------------
+
+#: (repo path, qualnames | "*") — the zero-RNG contract set: the code
+#: whose bit-identical-loss / cross-host-schedule pins depend on
+#: consuming no RNG.  "*" = every function in the module plus its
+#: top-level code.
+_RNG_CONTRACT: Sequence[Tuple[str, object]] = (
+    ("eksml_tpu/data/loader.py", ("DetectionLoader._materialize",
+                                  "DetectionLoader._substitute_for",
+                                  "DetectionLoader._resolve_image")),
+    ("eksml_tpu/telemetry/tracing.py", "*"),
+    ("eksml_tpu/telemetry/aggregate.py", "*"),
+)
+_RNG_PREFIXES = ("numpy.random.", "np.random.", "random.",
+                 "jax.random.")
+#: Method calls on an RNG-ish receiver: self.rng.shuffle(...),
+#: self._sched_rng.choice(...) — the loader's stateful streams.
+_RNG_RECEIVER = re.compile(r"(^|_)(rng|random_state)$")
+
+
+class RngDisciplineChecker:
+    """The zero-RNG contract set stays RNG-free through any chain.
+
+    The loader substitutes a quarantined record by walking dedicated
+    cursors precisely so batch shapes and the cross-host bucket/draw
+    schedule survive a single-host quarantine; tracing and aggregation
+    ride the hot path under bit-identical-loss pins.  ONE draw — even
+    two modules away — shifts that host's RNG stream and the whole
+    fleet's schedule agreement silently breaks (the deadlock surfaces
+    steps later, far from the cause).
+    """
+
+    rule = RULE_RNG
+
+    def check_graph(self, graph: ProjectGraph) -> List[Finding]:
+        findings: List[Finding] = []
+        reported: Set[int] = set()
+        for contract, quals in _RNG_CONTRACT:
+            for path in _paths_matching(graph, contract):
+                roots: List[FuncInfo] = []
+                if quals == "*":
+                    roots = [fi for fi in graph.functions
+                             if fi.path == path]
+                    roots.append(graph.module_scopes[path])
+                else:
+                    for q in quals:
+                        fi = graph.lookup(path, q)
+                        if fi is not None:
+                            roots.append(fi)
+                for fi, chain in graph.reachable(
+                        roots, unique_fallback=True).values():
+                    findings.extend(self._scan(graph, fi, chain,
+                                               contract, reported))
+        return findings
+
+    def _scan(self, graph: ProjectGraph, fi: FuncInfo,
+              chain: List[ChainEntry], contract_path: str,
+              reported: Set[int]) -> List[Finding]:
+        out: List[Finding] = []
+        nodes = (iter_scope(fi.node) if fi.is_module
+                 else ast.walk(fi.node))
+        for n in nodes:
+            if not isinstance(n, ast.Call) or id(n) in reported:
+                continue
+            what = self._rng_call(graph, fi.path, n)
+            if what is None:
+                continue
+            reported.add(id(n))
+            full = chain + [(fi.path, n.lineno, what)]
+            out.append(_finding(
+                graph.mods, self.rule, fi.path, n.lineno,
+                f"host RNG draw {what} is reachable from the zero-RNG "
+                f"contract set ({contract_path}) — quarantine "
+                "substitution, span tracing and telemetry aggregation "
+                "must consume NO RNG or the cross-host batch schedule "
+                "and the bit-identical-loss pins silently break; use "
+                "deterministic cursors (loader _sub_pos pattern) or "
+                "hoist the draw out of the contract path. "
+                f"chain: {format_chain(full)}",
+                chain=full))
+        return out
+
+    def _rng_call(self, graph: ProjectGraph, path: str,
+                  call: ast.Call) -> Optional[str]:
+        c = chain_of(call.func)
+        canon = graph.canonical(path, call.func)
+        for cand in filter(None, (canon, ".".join(c) if c else None)):
+            for prefix in _RNG_PREFIXES:
+                if cand.startswith(prefix):
+                    disp = ".".join(c) if c else cand
+                    return f"{disp}()"
+        if c is not None and len(c) >= 2 \
+                and _RNG_RECEIVER.search(c[-2]):
+            return ".".join(c) + "()"
+        return None
+
+
+# -- 3. host-sync ------------------------------------------------------
+
+_HOT_ROOTS: Sequence[Tuple[str, Tuple[str, ...]]] = (
+    ("eksml_tpu/train.py", ("Trainer.fit",)),
+    ("eksml_tpu/data/loader.py", ("DevicePrefetcher.__next__",
+                                  "DevicePrefetcher._produce")),
+)
+#: Once-per-incident / once-per-run boundaries the hot-path walk does
+#: not enter: restore, rollback, eval, capture setup, graceful exit,
+#: the first-call AOT compile — and the log-step aggregation collective
+#: (its blocking is the price of the fleet view, paid at LOG_PERIOD
+#: cadence, pinned legal by the bit-identity tests).  The replica sync
+#: check is SYNC_CHECK_PERIOD-gated debug mode — a deliberate sync.
+_SYNC_COLD = frozenset((
+    "restore_or_init", "init_state", "_load_backbone", "_rollback",
+    "_graceful_exit", "_run_eval", "_start_capture", "_finish_capture",
+    "_step_fn_with_prediction", "aggregate_host_scalars",
+    "assert_replicas_in_sync",
+))
+_SYNC_CANONICAL = ("jax.device_get", "jax.block_until_ready",
+                   "numpy.asarray", "numpy.array", "np.asarray",
+                   "np.array")
+
+
+class HostSyncChecker:
+    """Per-step host syncs on the hot loop are findings by default.
+
+    A ``.item()``/``np.asarray``/``device_get``/``block_until_ready``
+    on a device value stalls the host until the device catches up —
+    once per step, it serializes dispatch against execution and the
+    async prefetch win evaporates.  The rule is deliberately strict
+    inside the narrow hot set; the legal sites (loss materialization
+    at log steps, profiler capture boundaries) carry inline
+    ``# eksml-lint: disable=host-sync`` suppressions whose comments
+    justify the cadence.
+    """
+
+    rule = RULE_SYNC
+
+    def check_graph(self, graph: ProjectGraph) -> List[Finding]:
+        roots: List[FuncInfo] = []
+        for contract, quals in _HOT_ROOTS:
+            for path in _paths_matching(graph, contract):
+                for q in quals:
+                    fi = graph.lookup(path, q)
+                    if fi is not None:
+                        roots.append(fi)
+        findings: List[Finding] = []
+        reported: Set[int] = set()
+        for fi, chain in graph.reachable(
+                roots, unique_fallback=True,
+                stop_names=_SYNC_COLD).values():
+            findings.extend(self._scan(graph, fi, chain, reported))
+        return findings
+
+    def _scan(self, graph: ProjectGraph, fi: FuncInfo,
+              chain: List[ChainEntry],
+              reported: Set[int]) -> List[Finding]:
+        out: List[Finding] = []
+        for n in ast.walk(fi.node):
+            if not isinstance(n, ast.Call) or id(n) in reported:
+                continue
+            what = self._sync_call(graph, fi.path, n)
+            if what is None:
+                continue
+            reported.add(id(n))
+            full = chain + [(fi.path, n.lineno, what)]
+            out.append(_finding(
+                graph.mods, self.rule, fi.path, n.lineno,
+                f"per-step host sync {what} reachable from the hot "
+                "step path — the host blocks until the device drains, "
+                "serializing dispatch against execution every step; "
+                "move it behind a log/checkpoint-period predicate, or "
+                "if this site's cadence is already bounded, suppress "
+                "inline with a justification "
+                "(# eksml-lint: disable=host-sync). "
+                f"chain: {format_chain(full)}",
+                chain=full))
+        return out
+
+    def _sync_call(self, graph: ProjectGraph, path: str,
+                   call: ast.Call) -> Optional[str]:
+        c = chain_of(call.func)
+        canon = graph.canonical(path, call.func)
+        for cand in filter(None, (canon, ".".join(c) if c else None)):
+            if cand in _SYNC_CANONICAL:
+                return (".".join(c) if c else cand) + "()"
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr == "item" and not call.args:
+                return ".item()"
+            if call.func.attr == "block_until_ready":
+                return ".block_until_ready()"
+        return None
+
+
+# -- 4. recompile-hazard -----------------------------------------------
+
+def _is_jit_expr_node(node: ast.AST) -> bool:
+    c = chain_of(node)
+    return c is not None and c[-1] in ("jit", "pjit", "pmap")
+
+
+def _cfg_exempt(node: ast.AST) -> bool:
+    """len/shape of config-derived values is host-uniform and stable
+    across batches — the static-shape schedule itself lives in cfg
+    (PREPROC.BUCKETS), so cfg-rooted scalars never churn the cache."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "cfg" in n.id.lower():
+            return True
+        if isinstance(n, ast.Name) and n.id in ("config", "_C"):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr.lower() == "buckets":
+            return True
+    return False
+
+
+class RecompileHazardChecker:
+    """Batch-content Python scalars must not reach jitted callables.
+
+    Every distinct ``len(batch)``/``array.shape[i]`` value at a jitted
+    call site is a new entry in the compile cache (minutes of XLA work
+    at flagship shapes) — the failure mode the bucketed-padding
+    schedule exists to prevent, and the contract the serving path's
+    dynamic micro-batching front-end inherits.  Dict arguments whose
+    keys are built per batch change the pytree STRUCTURE, which
+    recompiles even when every shape matches.
+
+    Scope: names assigned from a ``*.jit(...)`` call and immediately-
+    invoked ``jax.jit(f)(...)`` forms.  Call sites of jit-DECORATED
+    functions are deliberately out of scope: they are routinely called
+    from inside traced code where a ``.shape[i]`` is a static constant
+    (documented blind spot).
+    """
+
+    rule = RULE_RECOMPILE
+
+    def check_graph(self, graph: ProjectGraph) -> List[Finding]:
+        findings: List[Finding] = []
+        for path, mod in graph.mods.items():
+            findings.extend(self._check_module(graph, path, mod))
+        return findings
+
+    def _check_module(self, graph: ProjectGraph, path: str,
+                      mod: ModuleInfo) -> List[Finding]:
+        jitted: Set[str] = set()
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Assign) and isinstance(n.value,
+                                                        ast.Call) \
+                    and _is_jit_expr_node(n.value.func):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        jitted.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        jitted.add(t.attr)
+        out: List[Finding] = []
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            name = None
+            f = n.func
+            if isinstance(f, ast.Name) and f.id in jitted:
+                name = f.id
+            elif isinstance(f, ast.Attribute) and f.attr in jitted:
+                name = f.attr
+            elif isinstance(f, ast.Call) and _is_jit_expr_node(f.func):
+                name = unparse(f.func)   # jax.jit(f)(...) immediate
+            if name is None:
+                continue
+            out.extend(self._check_args(graph, path, n, name))
+        return out
+
+    def _check_args(self, graph: ProjectGraph, path: str,
+                    call: ast.Call, name: str) -> List[Finding]:
+        out: List[Finding] = []
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        for i, arg in enumerate(args):
+            for n in ast.walk(arg):
+                what = None
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)
+                        and n.func.id == "len" and n.args
+                        and not _cfg_exempt(n.args[0])):
+                    what = f"len({unparse(n.args[0])})"
+                elif (isinstance(n, ast.Subscript)
+                      and isinstance(n.value, ast.Attribute)
+                      and n.value.attr == "shape"
+                      and not _cfg_exempt(n.value)):
+                    what = f"{unparse(n)}"
+                elif isinstance(n, ast.Dict) and any(
+                        not isinstance(k, ast.Constant)
+                        for k in n.keys):
+                    what = "dict with non-constant keys"
+                elif isinstance(n, ast.DictComp):
+                    what = "per-call dict comprehension"
+                if what is None:
+                    continue
+                out.append(_finding(
+                    graph.mods, self.rule, path, n.lineno,
+                    f"argument {i} of jitted callable '{name}' feeds "
+                    f"a batch-content Python scalar ({what}) into the "
+                    "compile-cache key — every distinct value (or "
+                    "pytree structure) compiles a new program, "
+                    "defeating the bucketed compile cache; route "
+                    "shapes through the static-shape schedule "
+                    "(PREPROC.BUCKETS -> data/loader.py assign_bucket"
+                    ") or mark genuinely-static config values, not "
+                    "batch content, as static args"))
+                break   # one finding per argument is enough
+        return out
+
+
+def build_spmd_checkers() -> List[object]:
+    return [CollectiveOrderChecker(), RngDisciplineChecker(),
+            HostSyncChecker(), RecompileHazardChecker()]

@@ -235,9 +235,14 @@ class CheckpointManager:
             self._quarantine(step)
 
     @staticmethod
-    def _agreed_ok(local_ok: bool) -> bool:
+    def all_hosts_ok(local_ok: bool) -> bool:
         """True iff EVERY host's flag is true (identity when
-        single-process)."""
+        single-process).  Public: any caller whose next action is a
+        collective must turn a host-local success/failure into ONE
+        fleet-wide verdict this way, or the failing host exits early
+        while the rest block in the collective forever — the
+        ``collective-order`` lint rule's early-exit class
+        (tools/eval_ckpt.py is the canonical consumer)."""
         if jax.process_count() <= 1:
             return local_ok
         import numpy as np
@@ -246,6 +251,9 @@ class CheckpointManager:
         flags = multihost_utils.process_allgather(
             np.int32(1 if local_ok else 0))
         return bool(np.min(flags) == 1)
+
+    # internal call sites predate the public name
+    _agreed_ok = all_hosts_ok
 
     @staticmethod
     def _coordinator_says(local_flag: bool) -> bool:

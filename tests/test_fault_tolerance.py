@@ -742,3 +742,139 @@ def test_quarantine_overflow_aborts_actionably(tmp_path, compile_cache,
         rc, out[-2000:])
     assert "MAX_QUARANTINE_FRAC" in out
     assert os.path.join(logdir, "quarantine-host0.jsonl") in out
+
+
+# ---- rung 11: rank-conditional collective skip (ISSUE 9) -------------
+
+RANK_SKIP_WORKER = r"""
+import os, sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from eksml_tpu.parallel import initialize_from_env
+
+initialize_from_env()
+assert jax.process_count() == 2, jax.process_count()
+
+from jax._src import distributed
+
+client = distributed.global_state.client
+# both ranks enter this barrier TOGETHER: proves the mechanism works
+# when the fleet is aligned, so the wedge below is unambiguously the
+# skipped entry, not a broken coordination service
+client.wait_at_barrier("aligned", timeout_in_ms=120000)
+print(f"worker {jax.process_index()} ALIGNED", flush=True)
+
+if jax.process_index() == 0:
+    # THE BUG under test: a rank-conditional cross-host barrier —
+    # rank 1 never enters, so rank 0 wedges in it until the deadline.
+    # eksml-lint's collective-order rule flags this exact construct.
+    client.wait_at_barrier("divergent", timeout_in_ms=600000)
+    print("BARRIER RETURNED", flush=True)
+print(f"worker {jax.process_index()} EXITING", flush=True)
+if jax.process_index() == 1:
+    # skip jax's atexit distributed-shutdown handshake (ITSELF a
+    # collective rank 0 will never join while wedged): this rank's
+    # hard departure while rank 0 waits is exactly the scenario
+    os._exit(0)
+"""
+
+
+def _spmd_free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_rank_conditional_collective_skip_hangs_and_lints(tmp_path):
+    """The lint finding and the distributed hang are the same bug,
+    proven once: on a real 2-process mesh (the 8-fake-device rig:
+    2 hosts x 4 CPU devices), rank 0 guards a cross-host barrier on
+    `process_index() == 0` — rank 1 skips it and exits cleanly while
+    rank 0 wedges inside the collective and never reaches the next
+    line.  The SAME worker source, linted, yields a collective-order
+    finding naming the guard and the chain to the barrier."""
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(RANK_SKIP_WORKER)
+
+    # -- static half: the worker source is a finding ------------------
+    from eksml_tpu.analysis import run_lint
+
+    r = run_lint(targets=[str(worker_py)], repo_root=str(tmp_path),
+                 rules=["collective-order"])
+    assert len(r.findings) == 1, r.findings
+    f = r.findings[0]
+    assert "wait_at_barrier" in f.message
+    assert "jax.process_index()" in f.message
+    assert f.chain[-1]["name"] == "wait_at_barrier"
+    # the aligned barrier both ranks enter is NOT a finding — only
+    # the divergent one
+    assert f.line == RANK_SKIP_WORKER.splitlines().index(
+        '    client.wait_at_barrier("divergent", '
+        'timeout_in_ms=600000)') + 1
+
+    # -- runtime half: the same construct wedges a real mesh ----------
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _spmd_free_port()
+    procs, logs, files = [], [], []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.update({
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": str(pid),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": repo,
+        })
+        log_path = str(tmp_path / f"skip-w{pid}.log")
+        logs.append(log_path)
+        logf = open(log_path, "w")  # PIPE deadlocks on XLA chatter
+        files.append(logf)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker_py)], env=env,
+            stdout=logf, stderr=subprocess.STDOUT))
+    try:
+        # both ranks must pass the aligned barrier first
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            if all("ALIGNED" in open(p).read() for p in logs):
+                break
+            if any(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.5)
+        assert all("ALIGNED" in open(p).read() for p in logs), (
+            "workers never reached the aligned barrier:\n"
+            + open(logs[0]).read()[-2000:] + "\n---\n"
+            + open(logs[1]).read()[-2000:])
+        # rank 1 (which SKIPS the divergent barrier) exits cleanly...
+        rc1 = procs[1].wait(timeout=120)
+        assert rc1 == 0, (rc1, open(logs[1]).read()[-2000:])
+        assert "worker 1 EXITING" in open(logs[1]).read()
+        # ...while rank 0 is wedged INSIDE the collective: 20s after
+        # its peer left, it has neither returned from the barrier nor
+        # exited — the distributed hang the watchdog can only report
+        # post-mortem, now statically flagged above.
+        try:
+            procs[0].wait(timeout=20)
+            wedged = False
+        except subprocess.TimeoutExpired:
+            wedged = True
+        out0 = open(logs[0]).read()
+        assert "BARRIER RETURNED" not in out0, out0[-2000:]
+        assert "worker 0 EXITING" not in out0, out0[-2000:]
+        assert wedged or procs[0].returncode != 0, (
+            procs[0].returncode, out0[-2000:])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        for f_ in files:
+            f_.close()

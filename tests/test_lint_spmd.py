@@ -1,0 +1,738 @@
+"""eksml-lint v2 (ISSUE 9): cross-module graph + the four SPMD rules.
+
+Covers the graph itself (import-alias resolution, ``__init__.py``
+re-exports, circular imports, local-shadowing precision, an impure
+call TWO modules away from its jit root), per-rule positive/negative/
+suppression fixtures for ``collective-order`` / ``rng-discipline`` /
+``host-sync`` / ``recompile-hazard``, the ``--json`` chain contract,
+the ``--changed`` pre-commit path, and the ISSUE 9 acceptance probes
+driven in both directions: the real tree exits 0 under all four rules
+(with the justified host-sync suppressions visible), and the two
+injection probes — a ``jax.process_index()`` guard around the
+aggregation allgather in a copy of telemetry/aggregate.py, an
+``np.random`` draw in a copy of the loader substitution path — exit 1
+naming rule, guard file:line and the call chain to the collective.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from eksml_tpu.analysis import run_lint
+from eksml_tpu.analysis.engine import iter_python_files, load_modules
+from eksml_tpu.analysis.graph import ProjectGraph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "tools", "eksml_lint.py")
+
+SPMD_RULES = ["collective-order", "rng-discipline", "host-sync",
+              "recompile-hazard"]
+
+
+def write_tree(tmp_path, files):
+    """{relpath: source} → files on disk; returns the tree root."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def lint_tree(tmp_path, files, rules, targets=None):
+    root = write_tree(tmp_path, files)
+    return run_lint(targets=targets or sorted(files),
+                    repo_root=str(root), rules=rules)
+
+
+def graph_of(tmp_path, files):
+    root = write_tree(tmp_path, files)
+    paths, _ = iter_python_files(sorted(files), str(root))
+    mods, errs = load_modules(paths, str(root))
+    assert not errs, errs
+    return ProjectGraph(mods)
+
+
+# ---------------------------------------------------------------------
+# the cross-module graph itself
+# ---------------------------------------------------------------------
+
+def test_graph_resolves_from_import_and_module_alias(tmp_path):
+    g = graph_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/util.py": "def helper():\n    return 1\n",
+        "main.py": """
+            import pkg.util as u
+            from pkg.util import helper as h
+
+            def a():
+                u.helper()
+
+            def b():
+                h()
+            """,
+    })
+    import ast
+
+    a = g.lookup("main.py", "a")
+    callees = [fi.qualname for _, fi in g.calls_from(a)]
+    assert callees == ["helper"]
+    b = g.lookup("main.py", "b")
+    assert [fi.path for _, fi in g.calls_from(b)] == ["pkg/util.py"]
+    # canonical names resolve aliases for the pattern checkers
+    call = next(n for n in ast.walk(a.node)
+                if isinstance(n, ast.Call))
+    assert g.canonical("main.py", call.func) == "pkg.util.helper"
+
+
+def test_graph_resolves_reexport_through_init(tmp_path):
+    g = graph_of(tmp_path, {
+        "pkg/__init__.py": "from pkg.impl import thing\n",
+        "pkg/impl.py": "def thing():\n    return 2\n",
+        "main.py": """
+            from pkg import thing
+            import pkg
+
+            def a():
+                thing()
+
+            def b():
+                pkg.thing()
+            """,
+    })
+    for fn in ("a", "b"):
+        fi = g.lookup("main.py", fn)
+        resolved = [c.path for _, c in g.calls_from(fi)]
+        assert resolved == ["pkg/impl.py"], (fn, resolved)
+
+
+def test_graph_survives_circular_imports(tmp_path):
+    g = graph_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": """
+            from pkg.b import bee
+
+            def aye():
+                bee()
+            """,
+        "pkg/b.py": """
+            from pkg.a import aye
+
+            def bee():
+                aye()
+            """,
+    })
+    aye = g.lookup("pkg/a.py", "aye")
+    assert [c.qualname for _, c in g.calls_from(aye)] == ["bee"]
+    # reachability terminates on the cycle and records the chain
+    reach = g.reachable([aye])
+    names = {fi.qualname for fi, _ in reach.values()}
+    assert names == {"aye", "bee"}
+
+
+def test_jit_purity_sees_impurity_two_modules_away(tmp_path):
+    """The v1 escape hatch, closed: root → mid → leaf, leaf impure."""
+    r = lint_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/mid.py": """
+            from pkg.leaf import stamp
+
+            def middle(x):
+                return stamp(x)
+            """,
+        "pkg/leaf.py": """
+            import time
+
+            def stamp(x):
+                return x + time.time()
+            """,
+        "main.py": """
+            import jax
+            from pkg.mid import middle
+
+            @jax.jit
+            def step(x):
+                return middle(x)
+            """,
+    }, rules=["jit-purity"])
+    assert len(r.findings) == 1
+    f = r.findings[0]
+    assert f.path == "pkg/leaf.py"
+    assert "time.time" in f.message and "'step'" in f.message
+
+
+def test_signal_safety_sees_telemetry_one_import_away(tmp_path):
+    r = lint_tree(tmp_path, {
+        "pub.py": """
+            def publish():
+                recorder.event("sigterm")
+            """,
+        "main.py": """
+            import signal
+            from pub import publish
+
+            def on_signal(signum, frame):
+                publish()
+
+            signal.signal(signal.SIGTERM, on_signal)
+            """,
+    }, rules=["signal-safety"])
+    assert len(r.findings) == 1
+    assert r.findings[0].path == "pub.py"
+    assert "recorder.event" in r.findings[0].message
+
+
+def test_graph_local_shadowing_blocks_false_resolution(tmp_path):
+    """A local `main = schedule(...)` must not resolve to the
+    module-level impure def main (the lr_schedule false-positive
+    class the first whole-repo run surfaced)."""
+    r = lint_tree(tmp_path, {
+        "mod.py": """
+            import jax, time
+
+            def main():
+                time.sleep(1)
+
+            def make():
+                return lambda s: s
+
+            @jax.jit
+            def step(x):
+                main = make()
+                return main(x)
+            """,
+    }, rules=["jit-purity"])
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------
+# collective-order
+# ---------------------------------------------------------------------
+
+COLLECTIVE_GUARD_SRC = """
+    import jax
+    from jax.experimental import multihost_utils
+
+    def publish(vec):
+        if jax.process_index() == 0:
+            return multihost_utils.process_allgather(vec)
+        return vec
+    """
+
+
+def test_collective_order_flags_rank_guarded_collective(tmp_path):
+    r = lint_tree(tmp_path, {"mod.py": COLLECTIVE_GUARD_SRC},
+                  rules=["collective-order"])
+    assert len(r.findings) == 1
+    f = r.findings[0]
+    assert "process_allgather" in f.message
+    assert "mod.py:6" in f.message          # the guard's file:line
+    assert "jax.process_index()" in f.message
+    assert f.chain and f.chain[-1]["name"] == "process_allgather"
+
+
+def test_collective_order_chain_through_other_module(tmp_path):
+    """Divergent guard two modules away from the collective: the
+    finding names the guard AND the full root→collective chain."""
+    r = lint_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/comm.py": """
+            from jax.experimental import multihost_utils
+
+            def gather_all(x):
+                return multihost_utils.process_allgather(x)
+            """,
+        "main.py": """
+            import jax
+            from pkg.comm import gather_all
+
+            def log_step(x):
+                pid = jax.process_index()
+                if pid == 0:
+                    return gather_all(x)
+                return x
+            """,
+    }, rules=["collective-order"])
+    assert len(r.findings) == 1
+    f = r.findings[0]
+    assert f.path == "main.py"
+    assert "'pid'" in f.message             # the aliased rank marker
+    names = [c["name"] for c in f.chain]
+    assert names == ["gather_all", "process_allgather"]
+    assert f.chain[1]["path"] == "pkg/comm.py"
+
+
+def test_collective_order_flags_collective_in_except_handler(tmp_path):
+    r = lint_tree(tmp_path, {"mod.py": """
+        from jax.experimental import multihost_utils
+
+        def restore(x):
+            try:
+                return load(x)
+            except Exception:
+                multihost_utils.broadcast_one_to_all(x)
+                return None
+        """}, rules=["collective-order"])
+    assert len(r.findings) == 1
+    assert "exception handler" in r.findings[0].message
+
+
+def test_collective_order_flags_divergent_early_return(tmp_path):
+    r = lint_tree(tmp_path, {"mod.py": """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def evaluate(x):
+            if jax.process_index() == 0:
+                if x is None:
+                    return {}
+            return multihost_utils.process_allgather(x)
+        """}, rules=["collective-order"])
+    assert len(r.findings) == 1
+    f = r.findings[0]
+    assert "early return" in f.message
+    assert "process_allgather" in f.message
+
+
+def test_collective_order_negatives(tmp_path):
+    r = lint_tree(tmp_path, {"mod.py": """
+        import jax
+        import logging
+        from jax.experimental import multihost_utils
+
+        log = logging.getLogger(__name__)
+
+        def uniform_guard(x):
+            # process_count is host-UNIFORM: every host branches alike
+            if jax.process_count() > 1:
+                return multihost_utils.process_allgather(x)
+            return x
+
+        def unconditional(x):
+            return multihost_utils.process_allgather(x)
+
+        def rank_guarded_local_work(x):
+            # divergent branch around NON-collective work is the
+            # normal coordinator pattern, not a finding
+            if jax.process_index() == 0:
+                log.info("coordinator: %s", x)
+            return x
+
+        def collective_in_test_position(x):
+            # inspecting an agreed verdict IS the fix pattern
+            if uniform_guard(x) is None:
+                return None
+            return x
+        """}, rules=["collective-order"])
+    assert r.findings == []
+
+
+def test_collective_order_suppression(tmp_path):
+    src = COLLECTIVE_GUARD_SRC.replace(
+        "return multihost_utils.process_allgather(vec)",
+        "return multihost_utils.process_allgather(vec)"
+        "  # eksml-lint: disable=collective-order")
+    r = lint_tree(tmp_path, {"mod.py": src},
+                  rules=["collective-order"])
+    assert r.findings == [] and len(r.suppressed) == 1
+
+
+def test_collective_order_flags_module_level_guard(tmp_path):
+    """The runtime hang pin's exact shape: module-level rank guard."""
+    r = lint_tree(tmp_path, {"worker.py": """
+        import jax
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0:
+            out = multihost_utils.process_allgather(np.int32(1))
+        """}, rules=["collective-order"])
+    assert len(r.findings) == 1
+    assert "process_allgather" in r.findings[0].message
+
+
+# ---------------------------------------------------------------------
+# rng-discipline
+# ---------------------------------------------------------------------
+
+LOADER_FIXTURE = """
+    import numpy as np
+    from eksml_tpu.data.subhelp import pick_replacement
+
+    class DetectionLoader:
+        def _draw(self):
+            # NOT in the contract set: the schedule draws are the
+            # legitimate RNG consumers
+            return int(self.rng.randint(0, 4))
+
+        def _substitute_for(self, failed_rec):
+            return pick_replacement(self.records, failed_rec)
+
+        def _materialize(self, rec, image):
+            return self._substitute_for(rec)
+    """
+
+
+def test_rng_discipline_flags_draw_two_modules_away(tmp_path):
+    r = lint_tree(tmp_path, {
+        "eksml_tpu/__init__.py": "",
+        "eksml_tpu/data/__init__.py": "",
+        "eksml_tpu/data/loader.py": LOADER_FIXTURE,
+        "eksml_tpu/data/subhelp.py": """
+            import numpy as np
+            from eksml_tpu.data.deeper import jitter
+
+            def pick_replacement(records, failed):
+                return records[jitter(len(records))]
+            """,
+        "eksml_tpu/data/deeper.py": """
+            import numpy as np
+
+            def jitter(n):
+                return np.random.randint(0, n)
+            """,
+    }, rules=["rng-discipline"], targets=["eksml_tpu"])
+    assert len(r.findings) == 1
+    f = r.findings[0]
+    assert f.path == "eksml_tpu/data/deeper.py"
+    assert "np.random.randint" in f.message
+    # the chain walks substitution → helper → draw
+    names = [c["name"] for c in f.chain]
+    assert names[-1] == "np.random.randint()"
+    assert any("pick_replacement" in n for n in names)
+
+
+def test_rng_discipline_flags_rng_receiver_method(tmp_path):
+    r = lint_tree(tmp_path, {
+        "eksml_tpu/__init__.py": "",
+        "eksml_tpu/data/__init__.py": "",
+        "eksml_tpu/data/loader.py": """
+            class DetectionLoader:
+                def _substitute_for(self, failed_rec):
+                    self.rng.shuffle(self._order)
+                    return self.records[0]
+            """,
+    }, rules=["rng-discipline"], targets=["eksml_tpu"])
+    assert len(r.findings) == 1
+    assert "self.rng.shuffle" in r.findings[0].message
+
+
+def test_rng_discipline_negative_draw_outside_contract(tmp_path):
+    r = lint_tree(tmp_path, {
+        "eksml_tpu/__init__.py": "",
+        "eksml_tpu/data/__init__.py": "",
+        "eksml_tpu/data/subhelp.py": "def pick_replacement(r, f):\n"
+                                     "    return r[0]\n",
+        "eksml_tpu/data/loader.py": LOADER_FIXTURE,
+    }, rules=["rng-discipline"], targets=["eksml_tpu"])
+    # _draw's self.rng use is the loader's legitimate schedule RNG
+    assert r.findings == []
+
+
+def test_rng_discipline_real_tracing_and_aggregate_clean():
+    r = run_lint(targets=["eksml_tpu/telemetry", "eksml_tpu/data"],
+                 repo_root=REPO, rules=["rng-discipline"])
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------
+
+def test_host_sync_flags_syncs_in_hot_loop_and_helper(tmp_path):
+    r = lint_tree(tmp_path, {
+        "eksml_tpu/__init__.py": "",
+        "eksml_tpu/train.py": """
+            import jax
+            import numpy as np
+            from eksml_tpu.helper import materialize
+
+            class Trainer:
+                def fit(self, batches):
+                    for batch in batches:
+                        state, metrics = self._step(state, batch)
+                        loss = metrics["total_loss"].item()
+                        materialize(metrics)
+
+                def _graceful_exit(self, metrics):
+                    # once-per-incident exit path: cold by design
+                    return float(np.asarray(metrics["total_loss"]))
+            """,
+        "eksml_tpu/helper.py": """
+            import jax
+
+            def materialize(tree):
+                jax.block_until_ready(tree)
+            """,
+    }, rules=["host-sync"], targets=["eksml_tpu"])
+    whats = sorted(f.message.split(" reachable")[0]
+                   for f in r.findings)
+    assert len(r.findings) == 2
+    assert ".item()" in whats[0] or ".item()" in whats[1]
+    helper = [f for f in r.findings
+              if f.path == "eksml_tpu/helper.py"]
+    assert helper and helper[0].chain[-1]["name"] \
+        == "jax.block_until_ready()"
+    # the cold path's sync did NOT flag
+    assert all(f.line != 15 for f in r.findings)
+
+
+def test_host_sync_suppression_with_justification(tmp_path):
+    r = lint_tree(tmp_path, {
+        "eksml_tpu/__init__.py": "",
+        "eksml_tpu/train.py": """
+            import numpy as np
+
+            class Trainer:
+                def fit(self, batches):
+                    for step, batch in enumerate(batches):
+                        metrics = self._step(batch)
+                        if step % 100 == 0:
+                            # log-step materialization, bounded cadence
+                            loss = float(np.asarray(metrics["l"]))  # eksml-lint: disable=host-sync
+            """,
+    }, rules=["host-sync"], targets=["eksml_tpu"])
+    assert r.findings == [] and len(r.suppressed) == 1
+
+
+def test_host_sync_real_tree_only_justified_suppressions():
+    r = run_lint(repo_root=REPO, rules=["host-sync"])
+    assert r.findings == []
+    # the four designed-legal sites in fit: two capture boundaries,
+    # the sentinel observation, the log-step materialization
+    assert len([s for s in r.suppressed
+                if s.path == "eksml_tpu/train.py"]) == 4
+
+
+# ---------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------
+
+def test_recompile_hazard_flags_len_shape_and_dict_keys(tmp_path):
+    r = lint_tree(tmp_path, {"mod.py": """
+        import jax
+
+        def f(x, n):
+            return x
+
+        step = jax.jit(f)
+
+        def run(batch, imgs):
+            step(imgs, len(batch["ids"]))
+            step(imgs, imgs.shape[0])
+            step({k: v for k, v in batch.items()}, 0)
+        """}, rules=["recompile-hazard"])
+    msgs = [f.message for f in r.findings]
+    assert len(r.findings) == 3
+    assert any("len(" in m for m in msgs)
+    assert any(".shape[" in m or "imgs.shape" in m for m in msgs)
+    assert any("dict comprehension" in m for m in msgs)
+    assert all("'step'" in m for m in msgs)
+
+
+def test_recompile_hazard_jitted_attr_and_immediate_call(tmp_path):
+    r = lint_tree(tmp_path, {"mod.py": """
+        import jax
+
+        class T:
+            def compile(self, fn):
+                self._jit_step = jax.jit(fn)
+
+            def run(self, state, batch):
+                return self._jit_step(state, len(batch))
+
+        def once(fn, batch):
+            return jax.jit(fn)(batch, len(batch))
+        """}, rules=["recompile-hazard"])
+    assert len(r.findings) == 2
+
+
+def test_recompile_hazard_negatives(tmp_path):
+    r = lint_tree(tmp_path, {"mod.py": """
+        import jax
+
+        def f(x, n):
+            return x
+
+        step = jax.jit(f, static_argnums=(1,))
+
+        def run(cfg, state, batch):
+            step(state, batch)                      # plain pytrees: ok
+            step(state, len(cfg.PREPROC.BUCKETS))   # cfg-derived: ok
+            step(state, cfg.DATA.MAX_GT_BOXES)      # config knob: ok
+
+        def host_side(batch):
+            return len(batch)                       # not a jit call
+        """}, rules=["recompile-hazard"])
+    assert r.findings == []
+
+
+# ---------------------------------------------------------------------
+# --json chain contract + --changed pre-commit path
+# ---------------------------------------------------------------------
+
+def _run_cli(*argv, cwd=REPO, env=None):
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    return subprocess.run([sys.executable, LINT, *argv],
+                          capture_output=True, text=True, cwd=cwd,
+                          env=e)
+
+
+def test_json_output_carries_root_to_collective_chain(tmp_path):
+    write_tree(tmp_path, {
+        "main.py": """
+            import jax
+            from jax.experimental import multihost_utils
+
+            def gather_all(x):
+                return multihost_utils.process_allgather(x)
+
+            def log_step(x):
+                if jax.process_index() == 0:
+                    return gather_all(x)
+                return x
+            """,
+    })
+    proc = _run_cli("--rules", "collective-order", "--json",
+                    str(tmp_path / "main.py"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    (finding,) = payload["findings"]
+    chain = finding["chain"]
+    assert [c["name"] for c in chain] == ["gather_all",
+                                          "process_allgather"]
+    assert all(set(c) == {"path", "line", "name"} for c in chain)
+    assert chain[0]["line"] == finding["line"]
+
+
+@pytest.fixture()
+def git_repo(tmp_path):
+    """A mini git repo wrapping the real CLI (so --changed diffs THIS
+    tree, not the production repo)."""
+    (tmp_path / "tools").mkdir()
+    shutil.copy(LINT, tmp_path / "tools" / "eksml_lint.py")
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", *args], cwd=tmp_path,
+                       check=True, capture_output=True)
+
+    clean = "def load(path):\n    return open(path).read()\n"
+    bad = ('def bank(path, p):\n    with open(path, "w") as f:\n'
+           "        f.write(p)\n")
+    (tmp_path / "mod_a.py").write_text(clean)
+    (tmp_path / "mod_b.py").write_text(bad)   # pre-existing debt
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    return tmp_path, git
+
+
+def test_changed_limits_findings_to_diffed_files(git_repo):
+    tmp_path, git = git_repo
+    cli = str(tmp_path / "tools" / "eksml_lint.py")
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def run(*argv):
+        return subprocess.run([sys.executable, cli, *argv],
+                              cwd=tmp_path, env=env,
+                              capture_output=True, text=True)
+
+    # nothing changed → fast exit 0 without linting.  (The base ref is
+    # --changed's optional VALUE, so targets go before the flag.)
+    proc = run("--rules", "atomic-write", "mod_a.py", "mod_b.py",
+               "--changed")
+    assert proc.returncode == 0 and "nothing to lint" in proc.stdout
+
+    # a violation added to mod_a: ONLY it is reported — mod_b's
+    # pre-existing debt stays out of the pre-commit scope
+    (tmp_path / "mod_a.py").write_text(
+        'def bank(path, p):\n    with open(path, "w") as f:\n'
+        "        f.write(p)\n")
+    proc = run("--rules", "atomic-write", "mod_a.py", "mod_b.py",
+               "--changed", "HEAD")
+    assert proc.returncode == 1
+    assert "mod_a.py" in proc.stdout and "mod_b.py" not in proc.stdout
+
+    # the full gate still sees both
+    proc = run("--rules", "atomic-write", "mod_a.py", "mod_b.py")
+    assert proc.returncode == 1
+    assert "mod_b.py" in proc.stdout
+
+    # --changed + --update-baseline is an error, not silent debt loss
+    proc = run("--changed", "--update-baseline", "mod_a.py")
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------
+# ISSUE 9 acceptance, both directions
+# ---------------------------------------------------------------------
+
+def test_real_tree_spmd_rules_clean():
+    """Forward direction: all four rules exit clean on the repo with
+    an EMPTY baseline (the justified exceptions are visible inline
+    suppressions, never grandfathered debt)."""
+    proc = _run_cli("--rules", ",".join(SPMD_RULES), "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["baselined"] == []
+
+
+def test_acceptance_rank_guard_on_aggregate_allgather(tmp_path):
+    """Reverse direction 1: a `jax.process_index() == 0` guard around
+    the aggregation allgather in a COPY of telemetry/aggregate.py →
+    rc 1 naming collective-order, the guard's file:line, and the call
+    chain to the collective."""
+    src = open(os.path.join(REPO, "eksml_tpu", "telemetry",
+                            "aggregate.py")).read()
+    needle = ("    gathered = np.asarray("
+              "multihost_utils.process_allgather(vec))")
+    assert needle in src, "aggregate.py changed; update this probe"
+    injected = src.replace(needle, (
+        "    if jax.process_index() == 0:\n"
+        "        gathered = np.asarray("
+        "multihost_utils.process_allgather(vec))\n"
+        "        return stats_from_matrix(gathered)\n"
+        "    gathered = vec[None, :]"))
+    target = tmp_path / "aggregate_copy.py"
+    target.write_text(injected)
+    proc = _run_cli("--rules", "collective-order", str(target))
+    assert proc.returncode == 1, proc.stdout
+    line = [ln for ln in proc.stdout.splitlines()
+            if "collective-order" in ln][0]
+    assert "process_allgather" in line
+    assert "jax.process_index()" in line
+    guard_line = injected.splitlines().index(
+        "    if jax.process_index() == 0:") + 1
+    assert f"aggregate_copy.py:{guard_line}" in line  # the guard
+    assert "chain:" in line
+
+
+def test_acceptance_np_random_in_loader_substitution(tmp_path):
+    """Reverse direction 2: an np.random draw injected into the loader
+    substitution path → rc 1 naming rng-discipline."""
+    src = open(os.path.join(REPO, "eksml_tpu", "data",
+                            "loader.py")).read()
+    needle = "        cycles.append((-1, self._order))"
+    assert needle in src, "loader.py changed; update this probe"
+    dst = tmp_path / "eksml_tpu" / "data"
+    dst.mkdir(parents=True)
+    (tmp_path / "eksml_tpu" / "__init__.py").write_text("")
+    (dst / "__init__.py").write_text("")
+    (dst / "loader.py").write_text(src.replace(
+        needle,
+        needle + "\n        skew = np.random.randint(0, 3)"))
+    proc = _run_cli("--rules", "rng-discipline",
+                    str(tmp_path / "eksml_tpu"))
+    assert proc.returncode == 1, proc.stdout
+    line = [ln for ln in proc.stdout.splitlines()
+            if "rng-discipline" in ln][0]
+    assert "np.random.randint" in line
+    assert "loader.py" in line

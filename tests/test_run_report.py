@@ -128,3 +128,43 @@ def test_max_events_caps_timeline(tmp_path):
     assert "30 event(s) recorded; showing the last 10" in report
     assert report.count("| quarantine |") == 10
     assert "quarantine×30" in report
+
+
+def test_hang_static_crosslink_section(tmp_path, monkeypatch):
+    """ISSUE 9: a watchdog hang report cross-links to collective-order
+    findings whose chain touches the stalled phase; without reports
+    the section degrades to a pointer; with a clean tree it says the
+    hang is not the statically-checkable class."""
+    from tools import run_report
+
+    # no hang report → pointer, never an error
+    text = run_report.render_report(str(tmp_path))
+    assert "nothing to cross-link" in text
+
+    (tmp_path / "hang_report_9_1.txt").write_text(
+        "eksml_tpu hang watchdog report #1\n"
+        "stalled phase: train_step\nstep: 12\n")
+    # clean tree → explicit "not the statically-checkable class"
+    text = run_report.render_report(str(tmp_path))
+    assert "stalled in phase `train_step`" in text
+    assert "not the statically-" in text
+
+    # a finding whose chain touches the stalled phase is marked
+    class _F:
+        path, line = "eksml_tpu/train.py", 7
+        chain = [
+            {"path": "eksml_tpu/train.py", "line": 7,
+             "name": "Trainer.train_step"},
+            {"path": "eksml_tpu/telemetry/aggregate.py", "line": 95,
+             "name": "process_allgather"},
+        ]
+
+    class _R:
+        findings, baselined = [_F()], []
+
+    import eksml_tpu.analysis as analysis
+
+    monkeypatch.setattr(analysis, "run_lint", lambda **kw: _R())
+    text = run_report.render_report(str(tmp_path))
+    assert "eksml_tpu/train.py:7" in text
+    assert "**yes**" in text

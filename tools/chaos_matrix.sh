@@ -18,6 +18,12 @@
 # real tree via tests/test_lint.py — the framework-invariant static
 # gate (jit purity, post-override config drift, signal-handler
 # safety, atomic writes, scope coverage, chart/values sync).
+# unit-lint-spmd runs the v2 cross-module SPMD rules (ISSUE 9:
+# collective-order, rng-discipline, host-sync, recompile-hazard) over
+# fixtures AND the real tree; proc-spmd-collective-skip is the
+# runtime counterpart: a rank-conditional collective skip on a real
+# 2-process mesh wedges/dies, and the SAME construct is flagged
+# statically — the lint finding and the hang are one bug, proven once.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # processes and are marked slow (excluded from tier-1); the unit and
 # data-* rungs run in seconds.  Everything runs under
@@ -46,6 +52,7 @@ RUNGS=(
   "unit-sharding|tests/test_sharding.py"
   "unit-perfgate|tests/test_perf_gate.py"
   "unit-lint|tests/test_lint.py"
+  "unit-lint-spmd|tests/test_lint_spmd.py"
   "data-corrupt-jpeg|'tests/test_fault_tolerance.py::test_data_fault_rung[corrupt-jpeg]'"
   "data-missing-file|'tests/test_fault_tolerance.py::test_data_fault_rung[missing-file]'"
   "data-eio-recover|'tests/test_fault_tolerance.py::test_data_fault_rung[eio-recover]'"
@@ -55,6 +62,7 @@ RUNGS=(
   "proc-corrupt-latest|tests/test_fault_tolerance.py::test_corrupt_latest_checkpoint_falls_back"
   "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"
   "proc-debugz-profile|tests/test_fault_tolerance.py::test_debugz_profile_capture_midrun_with_tracing"
+  "proc-spmd-collective-skip|tests/test_fault_tolerance.py::test_rank_conditional_collective_skip_hangs_and_lints"
   "proc-data-chaos|tests/test_fault_tolerance.py::test_data_chaos_train_completes_with_quarantine"
   "proc-data-breaker|tests/test_fault_tolerance.py::test_quarantine_overflow_aborts_actionably"
 )

@@ -12,6 +12,11 @@ Usage::
     python tools/eksml_lint.py                      # full gate
     python tools/eksml_lint.py --json               # machine output
     python tools/eksml_lint.py --rules atomic-write eksml_tpu/
+    python tools/eksml_lint.py --changed            # pre-commit path:
+                                                    # findings only in
+                                                    # files changed vs
+                                                    # HEAD (--changed
+                                                    # BASE for a ref)
     python tools/eksml_lint.py --update-baseline    # grandfather debt
                                                     # (then justify
                                                     # every entry!)
@@ -22,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -31,6 +37,25 @@ from eksml_tpu.analysis import ALL_RULES, load_baseline, run_lint  # noqa: E402
 from eksml_tpu.analysis.engine import format_human, write_baseline  # noqa: E402
 
 DEFAULT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def changed_paths(base: str, repo: str = REPO) -> list:
+    """Repo-relative paths of files changed vs *base* (``git diff
+    --name-only``) plus untracked files — the pre-commit scope."""
+    out = subprocess.run(["git", "diff", "--name-only", base, "--"],
+                         cwd=repo, capture_output=True, text=True)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"git diff --name-only {base} failed: "
+            f"{out.stderr.strip() or out.stdout.strip()}")
+    paths = [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=repo, capture_output=True, text=True)
+    if untracked.returncode == 0:
+        paths += [ln.strip() for ln in untracked.stdout.splitlines()
+                  if ln.strip()]
+    return sorted(set(paths))
 
 
 def main(argv=None) -> int:
@@ -50,14 +75,42 @@ def main(argv=None) -> int:
                         "every entry then needs a justified 'reason'")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="BASE",
+                   help="report findings only for files in `git diff "
+                        "--name-only BASE` (default HEAD) plus "
+                        "untracked files — the fast pre-commit path. "
+                        "The cross-module graph is still built over "
+                        "the full tree, so a changed caller is "
+                        "checked against unchanged callees")
     args = p.parse_args(argv)
 
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
     baseline = ([] if (args.no_baseline or args.update_baseline)
                 else load_baseline(args.baseline))
+    if args.changed is not None and args.update_baseline:
+        # the merge in write_baseline keys "still present" off the
+        # checked files; a path-filtered result would silently kill
+        # grandfathered entries for unchanged files
+        print("eksml-lint: --changed cannot be combined with "
+              "--update-baseline (a scoped result would drop "
+              "out-of-scope baseline entries)", file=sys.stderr)
+        return 2
+    only_paths = None
+    if args.changed is not None:
+        try:
+            only_paths = changed_paths(args.changed)
+        except RuntimeError as e:
+            print(f"eksml-lint: {e}", file=sys.stderr)
+            return 2
+        if not only_paths:
+            print(f"eksml-lint: no files changed vs {args.changed} — "
+                  "nothing to lint")
+            return 0
     result = run_lint(targets=args.targets or None, repo_root=REPO,
-                      rules=rules, baseline=baseline)
+                      rules=rules, baseline=baseline,
+                      only_paths=only_paths)
 
     if args.update_baseline:
         # scoped updates merge: out-of-scope grandfathered entries and

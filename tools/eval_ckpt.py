@@ -86,10 +86,20 @@ def main(argv=None):
     state = trainer.init_state(trainer._globalize_batch(example))
     try:
         state = trainer.ckpt.restore(state, step=at_step)
+        restore_err = None
     except Exception as e:  # noqa: BLE001 — pruned/missing step
-        print(f"eval_ckpt: restore of step {at_step} failed "
-              f"({type(e).__name__}: {e}); available: "
-              f"{os.listdir(trainer.ckpt.directory)}", file=sys.stderr)
+        restore_err = e
+    # The restore verdict must be ONE decision for the whole fleet:
+    # run_evaluation's detection gather below is a collective, and a
+    # lone host returning early here (stale NFS handle, pruned step
+    # visible to one attribute cache) would leave every other host
+    # blocked in the allgather forever — the collective-order class
+    # eksml-lint flags statically, fixed by agreeing first.
+    if not trainer.ckpt.all_hosts_ok(restore_err is None):
+        print(f"eval_ckpt: restore of step {at_step} failed on at "
+              f"least one host (local error: {restore_err!r}); "
+              f"available: {os.listdir(trainer.ckpt.directory)}",
+              file=sys.stderr)
         return 1
 
     records = CocoDataset(data_dir, args.split).records(skip_empty=False)

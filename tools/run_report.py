@@ -23,6 +23,11 @@ tool folds them into one reviewable report:
   (``trace-host<i>.json``, TELEMETRY.TRACING), the cross-host merge
   names the dominant span of each outlier step — "step 412: host 3,
   1.9 s in data_wait" — via ``tools/trace_summary.py``'s merge.
+- **Static SPMD cross-link**: when the logdir holds watchdog hang
+  reports, the tree is audited with eksml-lint's ``collective-order``
+  rule and any finding whose root→collective chain touches the
+  stalled phase is flagged — the hang and the lint finding are the
+  same divergence bug, proven once.
 - **Modeled cost**: the attribution component table, when the run
   banked a profile.
 - **Predicted vs measured**: the perf-gate prediction bank
@@ -274,6 +279,70 @@ def _attribution_section(logdir: str,
     return lines
 
 
+def _hang_static_section(logdir: str) -> List[str]:
+    """Cross-link a watchdog hang report to a matching static
+    ``collective-order`` finding (eksml-lint v2).  The lint finding
+    and the hang are the same bug: a host-divergent path into (or
+    around) a collective.  When a hang report names a stalled phase
+    and a finding's root→collective chain touches a function whose
+    name matches it, the report says so — post-mortem and prevention
+    joined in one table."""
+    lines = ["## Static SPMD cross-link (watchdog ↔ eksml-lint)"]
+    # newest by mtime: the names are hang_report_<pid>_<fires>.txt, so
+    # a lexicographic sort is arbitrary across restarts (pid order)
+    # and wraps within one process at fires=10
+    reports = sorted(glob.glob(os.path.join(logdir,
+                                            "hang_report_*.txt")),
+                     key=os.path.getmtime)
+    if not reports:
+        lines += ["", "No watchdog hang reports in this logdir — "
+                      "nothing to cross-link.  (`python "
+                      "tools/eksml_lint.py --rules collective-order "
+                      "--json` audits the tree on demand.)"]
+        return lines
+    phase = None
+    try:
+        with open(reports[-1]) as f:
+            for ln in f:
+                if ln.startswith("stalled phase:"):
+                    phase = ln.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    lines += ["", f"{len(reports)} hang report(s); newest "
+                  f"`{os.path.basename(reports[-1])}` stalled in "
+                  f"phase `{phase or '?'}`."]
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from eksml_tpu.analysis import run_lint
+
+        result = run_lint(rules=["collective-order"])
+        findings = list(result.findings) + list(result.baselined)
+    except Exception as e:  # noqa: BLE001 — partial evidence is fine
+        lines += ["", f"Static analysis unavailable: {e!r}"]
+        return lines
+    if not findings:
+        lines += ["", "No static `collective-order` findings in the "
+                      "tree — this hang is not the statically-"
+                      "checkable divergence class (look at the "
+                      "stalled phase's stack in the report; a "
+                      "data-dependent skip or an external peer death "
+                      "are the usual suspects)."]
+        return lines
+    lines += ["", "| finding | chain | matches stalled phase |",
+              "|---|---|---|"]
+    for fnd in findings:
+        chain = fnd.chain or []
+        chain_s = " → ".join(f"{c['path']}:{c['line']} {c['name']}"
+                             for c in chain) or "-"
+        hit = bool(phase) and any(
+            phase in c.get("name", "") for c in chain)
+        lines.append(f"| {fnd.path}:{fnd.line} "
+                     f"| {chain_s} | {'**yes**' if hit else 'no'} |")
+    return lines
+
+
 def _predicted_section(artifacts_dir: Optional[str]) -> List[str]:
     """Predicted-vs-measured step-time table from the perf-gate bank
     (ISSUE 7), degrading to a pointer exactly like the span-tracing
@@ -360,6 +429,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.extend(_events_section(events, max_events))
     lines.append("")
     lines.extend(_slow_steps_section(logdir))
+    lines.append("")
+    lines.extend(_hang_static_section(logdir))
     lines.append("")
     lines.extend(_attribution_section(logdir, attribution))
     lines.append("")
