@@ -467,6 +467,18 @@ def _define_defaults() -> None:
     # per-file sha256 in the post-commit integrity manifest (sizes are
     # always recorded; digests re-read every checkpoint byte at save)
     _C.RESILIENCE.CHECKPOINT_DIGEST = False
+    # elastic topology (parallel/topology.py + utils/checkpoint.py):
+    # every checkpoint step records the topology it was saved on (mesh
+    # shape/axes, TPU.NUM_SLICES, sharding strategy, fsdp axis size,
+    # device/process counts) next to its integrity manifest.  True =
+    # a relaunch at a DIFFERENT topology reshards the restore onto
+    # the current mesh (grow or shrink: v5e-32 -> v5e-8 and back,
+    # fsdp axis resize, slice-count change) and emits the
+    # checkpoint_resharded event + counter with a saved->current
+    # diff.  False = a topology-mismatched restore fails fast with an
+    # actionable error naming this knob — for fleets where a topology
+    # change is only ever operator error.
+    _C.RESILIENCE.ELASTIC_RESUME = True
     # consecutive non-finite total_loss observations before rolling
     # back to the last good checkpoint
     _C.RESILIENCE.NAN_PATIENCE = 3

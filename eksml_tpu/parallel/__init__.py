@@ -21,3 +21,4 @@ from eksml_tpu.parallel.collectives import (  # noqa: F401
 from eksml_tpu.parallel.sharding import (  # noqa: F401
     ShardingPlan, match_partition_rules, plan_mesh,
     tree_bytes_per_device)
+from eksml_tpu.parallel.topology import current_topology  # noqa: F401

@@ -1,0 +1,111 @@
+"""Topology descriptors: what a checkpoint was saved ON.
+
+The elastic-resume subsystem (ROADMAP item 4) makes checkpoints
+topology-portable: a run saved on v5e-32 relaunches on v5e-8 (or the
+other way around), a multi-slice job grows or shrinks its
+``TPU.NUM_SLICES`` between launches, and an fsdp axis resizes with the
+device count.  The restore side re-derives its mesh from the CURRENT
+config/devices (``plan_mesh`` + ``build_mesh`` run fresh every
+launch); what it cannot re-derive is what the checkpoint was written
+*on* — that is this module's descriptor, persisted per step by the
+integrity layer (``resilience/integrity.py`` topology manifests) and
+compared at restore time by ``utils/checkpoint.py``.
+
+A descriptor is a plain JSON-serializable dict (one key per
+:data:`FIELDS` entry) so the manifest schema is greppable and
+diffable; :func:`describe` and :func:`diff` render the operator-facing
+one-liners the restore log and flight recorder carry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: Manifest payload schema version (bump on incompatible field
+#: changes; readers treat unknown versions as "no manifest").
+SCHEMA_VERSION = 1
+
+#: Descriptor fields, in render order.  ANY differing field makes two
+#: topologies incompatible for a byte-layout-trusting restore — the
+#: elastic path reshards, the non-elastic path fails fast.
+FIELDS = ("mesh_shape", "mesh_axes", "num_slices", "strategy",
+          "fsdp_axis_size", "num_devices", "process_count")
+
+
+def current_topology(mesh, plan, num_slices: int = 1) -> Dict[str, Any]:
+    """Descriptor of the topology THIS process is training on.
+
+    ``mesh`` is the live :class:`jax.sharding.Mesh`; ``plan`` the
+    active :class:`~eksml_tpu.parallel.sharding.ShardingPlan` (its
+    ``axis_size`` is the RESOLVED fsdp width, not the raw knob — a
+    knob of 0 means "per-slice device count" and would alias distinct
+    layouts).
+    """
+    import jax
+
+    return {
+        "mesh_shape": [int(s) for s in mesh.devices.shape],
+        "mesh_axes": [str(a) for a in mesh.axis_names],
+        "num_slices": int(num_slices),
+        "strategy": str(plan.strategy),
+        "fsdp_axis_size": int(plan.axis_size),
+        "num_devices": int(mesh.devices.size),
+        "process_count": int(jax.process_count()),
+    }
+
+
+def normalize(topo: Any) -> Optional[Dict[str, Any]]:
+    """Tolerant load of a (possibly hand-edited / cross-version)
+    descriptor: every known field, sequences as lists, or ``None``
+    when the payload is not a dict at all."""
+    if not isinstance(topo, dict):
+        return None
+    out: Dict[str, Any] = {}
+    for f in FIELDS:
+        v = topo.get(f)
+        out[f] = list(v) if isinstance(v, (list, tuple)) else v
+    return out
+
+
+def compatible(saved: Any, current: Any) -> bool:
+    """True when a checkpoint saved at ``saved`` can be restored at
+    ``current`` trusting the byte layout as-is (every descriptor field
+    equal).  Absence is never a mismatch — a whole missing descriptor
+    (no manifest) AND a per-field ``None`` (a manifest written before
+    a field joined :data:`FIELDS`) both mean "no evidence", so only
+    fields recorded on BOTH sides are compared; otherwise adding a
+    field would make every pre-upgrade checkpoint read as saved on a
+    different topology."""
+    a, b = normalize(saved), normalize(current)
+    if a is None or b is None:
+        return True
+    return all(a[f] == b[f] for f in FIELDS
+               if a[f] is not None and b[f] is not None)
+
+
+def describe(topo: Any) -> str:
+    """One-line descriptor for logs/events:
+    ``mesh [1, 8, 1] over ['data', 'fsdp', 'model'], fsdp(8), 1
+    slice(s), 8 device(s), 1 proc(s)``."""
+    t = normalize(topo)
+    if t is None:
+        return "(unknown topology)"
+    strat = t["strategy"]
+    if strat == "fsdp":
+        strat = f"fsdp({t['fsdp_axis_size']})"
+    return (f"mesh {t['mesh_shape']} over {t['mesh_axes']}, {strat}, "
+            f"{t['num_slices']} slice(s), {t['num_devices']} "
+            f"device(s), {t['process_count']} proc(s)")
+
+
+def diff(saved: Any, current: Any) -> str:
+    """One-line saved→current diff naming ONLY the changed fields —
+    the operator-facing payload of the ``checkpoint_resharded`` event
+    and the restore log line."""
+    a, b = normalize(saved), normalize(current)
+    if a is None or b is None:
+        return f"{describe(saved)} -> {describe(current)}"
+    # per-field absence is "no evidence", matching compatible()
+    parts = [f"{f}: {a[f]} -> {b[f]}" for f in FIELDS
+             if a[f] is not None and b[f] is not None and a[f] != b[f]]
+    return "; ".join(parts) if parts else "(identical topologies)"

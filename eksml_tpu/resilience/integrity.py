@@ -49,6 +49,48 @@ def manifest_path(root: str, step: int) -> str:
     return os.path.join(root, MANIFEST_DIRNAME, f"{step}.json")
 
 
+def topology_manifest_path(root: str, step: int) -> str:
+    """Topology manifest for a step, next to its integrity manifest
+    (``.topology.json`` keeps it out of :func:`list_manifest_steps`'s
+    digit namespace)."""
+    return os.path.join(root, MANIFEST_DIRNAME, f"{step}.topology.json")
+
+
+def write_topology_manifest(root: str, step: int, topo: Dict) -> str:
+    """Atomically publish the topology descriptor a step was saved on
+    (``parallel/topology.py`` dict) — the elastic-resume subsystem's
+    evidence for the reshard-vs-trust decision at restore time."""
+    from eksml_tpu.parallel import topology
+
+    path = topology_manifest_path(root, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": topology.SCHEMA_VERSION,
+                   "topology": topology.normalize(topo)}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # readers see a whole manifest or none
+    return path
+
+
+def read_topology_manifest(root: str, step: int) -> Optional[Dict]:
+    """The topology descriptor a step was saved on, or ``None`` when
+    the manifest is absent, torn, or from an unknown schema version —
+    all three mean "no topology evidence", never an error (pre-elastic
+    checkpoints have no manifest and must keep restoring)."""
+    from eksml_tpu.parallel import topology
+
+    try:
+        with open(topology_manifest_path(root, step)) as f:
+            payload = json.load(f)
+        if payload.get("version") != topology.SCHEMA_VERSION:
+            return None
+        return topology.normalize(payload.get("topology"))
+    except (OSError, ValueError, AttributeError):
+        return None
+
+
 def _walk_files(step_dir: str) -> List[str]:
     out = []
     for base, _dirs, files in os.walk(step_dir):
@@ -121,6 +163,20 @@ def prune_manifests(root: str, keep_steps) -> None:
                 os.remove(manifest_path(root, step))
             except OSError:
                 pass
+    # topology manifests follow the same retention — ONE sweep covers
+    # both the pruned steps above and orphans whose integrity manifest
+    # never landed (writer died between the two writes)
+    d = os.path.join(root, MANIFEST_DIRNAME)
+    if os.path.isdir(d):
+        for p in os.listdir(d):
+            if not p.endswith(".topology.json"):
+                continue
+            stem = p[:-len(".topology.json")]
+            if stem.isdigit() and int(stem) not in keep:
+                try:
+                    os.remove(os.path.join(d, p))
+                except OSError:
+                    pass
 
 
 def verify_step(root: str, step: int,
@@ -223,10 +279,12 @@ def quarantine_step(root: str, step: int) -> Optional[str]:
         log.warning("could not quarantine checkpoint step %d: %s",
                     step, e)
         return None
-    try:
-        os.remove(manifest_path(root, step))
-    except OSError:
-        pass
+    for path in (manifest_path(root, step),
+                 topology_manifest_path(root, step)):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
     log.warning("quarantined corrupt checkpoint step %d -> %s",
                 step, os.path.basename(target))
     return target

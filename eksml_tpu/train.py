@@ -42,7 +42,8 @@ from flax import struct
 from eksml_tpu.config import config as global_config
 from eksml_tpu.config import config_from_env, finalize_configs
 from eksml_tpu.models import MaskRCNN
-from eksml_tpu.parallel import (build_mesh, initialize_from_env,
+from eksml_tpu.parallel import (build_mesh, current_topology,
+                                initialize_from_env,
                                 replicated_sharding, validate_topology,
                                 warm_mesh_collectives)
 from eksml_tpu.parallel.sharding import (ShardingPlan, plan_mesh,
@@ -238,6 +239,8 @@ def _preregister_core_metrics(registry) -> None:
         ("eksml_checkpoint_restores", "checkpoint restores completed"),
         ("eksml_checkpoint_fallbacks",
          "checkpoint integrity walk-backs to an earlier step"),
+        ("eksml_checkpoint_restore_resharded",
+         "checkpoint restores resharded across a topology change"),
     ):
         registry.counter(name, help_text)
     # the quarantine census is labeled by fault kind everywhere it
@@ -345,9 +348,6 @@ class Trainer:
                 if prev_t is not None:
                     prev_t.flush()
                 self.tracer = telemetry.get_tracer()
-        self.ckpt = CheckpointManager(
-            logdir, digest=cfg.RESILIENCE.CHECKPOINT_DIGEST)
-
         # the plan owns every layout decision: batch spec, state
         # specs, and (via plan.jit) strategy executability — the
         # hard-coded PartitionSpec("data") / replicated pair is gone
@@ -355,6 +355,20 @@ class Trainer:
         if jax.process_index() == 0:
             log.info("sharding plan: %s over mesh %s",
                      self.plan.describe(), dict(self.mesh.shape))
+        # the checkpoint manager carries THIS launch's topology
+        # descriptor (persisted per step, compared at restore): mesh
+        # shape/axes, slices, strategy, resolved fsdp width, device +
+        # process counts — everything the restore side re-derives
+        # fresh each launch and therefore cannot recover from the
+        # checkpoint bytes alone.  getattr fallback: config trees
+        # predating the elastic knob keep working (elastic on, the
+        # default)
+        self.ckpt = CheckpointManager(
+            logdir, digest=cfg.RESILIENCE.CHECKPOINT_DIGEST,
+            topology=current_topology(self.mesh, self.plan,
+                                      num_slices=cfg.TPU.NUM_SLICES),
+            elastic=bool(getattr(cfg.RESILIENCE, "ELASTIC_RESUME",
+                                 True)))
         self._batch_sharding = self.plan.batch_sharding()
         self._replicated = replicated_sharding(self.mesh)
         # refined to the plan's per-leaf tree once init_state knows
@@ -448,7 +462,17 @@ class Trainer:
         When the plan is NOT replicated, a replicated-layout fallback
         target rides along — a checkpoint an older (replicated) run
         committed still restores even when the plan-sharded restore
-        cannot, and the device_put below re-applies the plan's specs."""
+        cannot, and the device_put below re-applies the plan's specs.
+
+        Topology-portable (ROADMAP item 4): everything topology-
+        dependent was re-derived for THIS launch before we get here —
+        ``plan_mesh``/``build_mesh`` from the current config/devices,
+        the per-host batch from the current mesh, the data schedule
+        from the current host count — so the targets describe the
+        CURRENT topology and the manager reshards a checkpoint saved
+        at another one (``RESILIENCE.ELASTIC_RESUME``): a preempted
+        v5e-32 run relaunched on v5e-8 (or a shrunk/grown
+        ``TPU.NUM_SLICES``) resumes from its forced checkpoint."""
         state = self.init_state(example_batch)
         restored = self.ckpt.restore_with_fallback(
             state, alt_state_like=self._alt_restore_target(state))

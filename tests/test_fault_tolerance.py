@@ -21,6 +21,13 @@ resilience layer (eksml_tpu/resilience/); each rung here drives a real
                       the sentinel refuses to checkpoint the poison,
                       rolls back to the last good step, and the run
                       still completes.
+  elastic-resume      SIGTERM at one topology, relaunch at another
+                      (8 chips fsdp(8) → 4 chips fsdp(4) → back to 8,
+                      global batch held): each crossing reshards the
+                      restore onto the freshly-derived mesh
+                      (checkpoint_resharded event + saved→current
+                      diff) and the loss stream continues from the
+                      forced checkpoint (ISSUE 10).
 
 Data-ingest rungs (eksml_tpu/data/robust.py, ISSUE 2):
 
@@ -86,10 +93,12 @@ def compile_cache(tmp_path_factory):
     return str(tmp_path_factory.mktemp("xla_cache"))
 
 
-def _launch(logdir, cache_dir, log_path, config=TINY, synthetic=True):
+def _launch(logdir, cache_dir, log_path, config=TINY, synthetic=True,
+            extra_env=None):
     env = dict(os.environ)
     env.update({"EKSML_PLATFORM": "cpu",
                 "JAX_COMPILATION_CACHE_DIR": cache_dir})
+    env.update(extra_env or {})
     cmd = [sys.executable, "-m", "eksml_tpu.train", "--logdir", logdir]
     if synthetic:
         cmd.append("--synthetic")
@@ -531,6 +540,125 @@ def test_debugz_profile_capture_midrun_with_tracing(tmp_path,
     losses2 = {r["step"]: r["total_loss"]
                for r in _metric_rows(logdir2) if "total_loss" in r}
     assert losses1 == losses2, "tracing perturbed the loss stream"
+
+
+# ---- rung 4c: elastic topology grow/shrink relaunch (ISSUE 10) -------
+
+
+def _device_count_env(n):
+    """Child env overriding the conftest-inherited 8-fake-device rig:
+    the relaunched trainer sees a DIFFERENT topology (the preemptible-
+    capacity scenario: the fleet shrank or grew between launches).
+    Only the device-count flag is substituted — any other inherited
+    XLA_FLAGS must reach the relaunch unchanged, or the grow/shrink
+    children would run under a different XLA configuration than run A
+    and skew the loss-stream comparison."""
+    kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={n}")
+    return {"XLA_FLAGS": " ".join(kept)}
+
+
+def _elastic_config(chips, batch_per_chip, epochs):
+    """fsdp config at a given device count, holding the GLOBAL batch
+    (chips × batch) at 8 so the LR schedule, steps/epoch and loss
+    stream are comparable across topologies."""
+    return [c for c in TINY if "MAX_EPOCHS" not in c] + [
+        f"TRAIN.MAX_EPOCHS={epochs}",
+        f"TRAIN.NUM_CHIPS={chips}",
+        f"TRAIN.BATCH_SIZE_PER_CHIP={batch_per_chip}",
+        "TRAIN.SHARDING.STRATEGY=fsdp",
+    ]
+
+
+@pytest.mark.slow
+def test_elastic_resume_grow_shrink(tmp_path, compile_cache):
+    """Chaos rung (ISSUE 10): SIGTERM a run at topology A (8 chips,
+    fsdp(8)), relaunch at topology B (4 chips, fsdp(4), same global
+    batch) — the relaunch reshards the forced checkpoint onto the new
+    mesh, logs the saved→current diff, records the
+    ``checkpoint_resharded`` event, and continues the loss stream from
+    the forced step.  Then grow BACK to 8 chips from B's final
+    checkpoint: the other direction reshards too and the run completes
+    its extended schedule."""
+    logdir = str(tmp_path / "run")
+
+    # -- topology A: 8 chips, killed mid-run --------------------------
+    log1 = str(tmp_path / "run1.log")
+    proc = _launch(logdir, compile_cache, log1,
+                   _elastic_config(8, 1, epochs=3))  # 6 steps
+    try:
+        _wait_for_first_step(proc, logdir, log1)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    first_steps = _steps_logged(logdir)
+    if rc == 0 and max(first_steps) >= 6:
+        pytest.skip("run outran the signal on this machine — "
+                    "inconclusive")
+    from eksml_tpu.config import config as global_config
+
+    assert rc == global_config.RESILIENCE.PREEMPT_EXIT_CODE, (
+        rc, open(log1).read()[-2000:])
+    committed = _committed_ckpt_steps(logdir)
+    assert committed, "graceful preemption must leave a checkpoint"
+    forced = max(committed)
+
+    # -- topology B: SHRINK to 4 chips, complete the schedule ---------
+    log2 = str(tmp_path / "run2.log")
+    proc2 = _launch(logdir, compile_cache, log2,
+                    _elastic_config(4, 2, epochs=3),
+                    extra_env=_device_count_env(4))
+    try:
+        assert proc2.wait(timeout=900) == 0, open(log2).read()[-2000:]
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+    out2 = open(log2).read()
+    assert f"resuming from checkpoint step {forced}" in out2
+    assert "resharded across a topology change" in out2
+    # the one-line saved→current diff names the shrink
+    assert "num_devices: 8 -> 4" in out2
+    steps = _steps_logged(logdir)
+    shrink_steps = steps[len(first_steps):]
+    assert shrink_steps == list(range(forced + 1, 7)), (
+        forced, first_steps, shrink_steps)
+    # flight recorder: the reshard landed between restore and the
+    # continued stream
+    kinds = _event_kinds(logdir)
+    assert "checkpoint_resharded" in kinds, kinds
+    assert "checkpoint_restore" in kinds, kinds
+
+    # -- topology C: GROW back to 8 chips on an extended schedule -----
+    log3 = str(tmp_path / "run3.log")
+    proc3 = _launch(logdir, compile_cache, log3,
+                    _elastic_config(8, 1, epochs=5))  # 10 steps total
+    try:
+        assert proc3.wait(timeout=900) == 0, open(log3).read()[-2000:]
+    finally:
+        if proc3.poll() is None:
+            proc3.kill()
+    out3 = open(log3).read()
+    assert "resuming from checkpoint step 6" in out3
+    assert "resharded across a topology change" in out3
+    assert "num_devices: 4 -> 8" in out3
+    steps = _steps_logged(logdir)
+    grow_steps = steps[len(first_steps) + len(shrink_steps):]
+    assert grow_steps == list(range(7, 11)), grow_steps
+    # the loss stream stayed finite through both topology crossings
+    rows = {r["step"]: r["total_loss"] for r in _metric_rows(logdir)
+            if "total_loss" in r}
+    assert all(math.isfinite(v) for v in rows.values()), rows
+    # two reshard events total (shrink + grow), visible to run_report
+    kinds = _event_kinds(logdir)
+    assert kinds.count("checkpoint_resharded") == 2, kinds
+    from tools import run_report
+
+    report = run_report.render_report(logdir)
+    assert "## Elastic resume (topology changes)" in report
+    assert "num_devices: 4 -> 8" in report
 
 
 # ---- rungs 5-7: data-ingest faults (loader level, in-process) --------

@@ -715,6 +715,45 @@ def test_acceptance_rank_guard_on_aggregate_allgather(tmp_path):
     assert "chain:" in line
 
 
+def test_acceptance_rank_guard_on_elastic_reshard_verdict(tmp_path):
+    """Elastic-restore collective-order audit (ISSUE 10): the
+    topology-mismatch verdict in ``restore_with_fallback`` rides a
+    fleet-wide broadcast, and the forward direction
+    (test_real_tree_spmd_rules_clean) proves the shipped path carries
+    no suppression.  Reverse direction here: a copy of checkpoint.py
+    with that verdict moved behind a ``jax.process_index() == 0``
+    guard — the exact bug that would let one host take the reshard
+    branch while the rest trust the saved layout — must be flagged by
+    ``collective-order``, naming the guard and the chain down to the
+    broadcast."""
+    src = open(os.path.join(REPO, "eksml_tpu", "utils",
+                            "checkpoint.py")).read()
+    needle = ("            saved_topo, mismatch = "
+              "self._topology_verdict(step)")
+    assert needle in src, "checkpoint.py changed; update this probe"
+    injected = src.replace(needle, (
+        "            if jax.process_index() == 0:\n"
+        "                saved_topo, mismatch = "
+        "self._topology_verdict(step)\n"
+        "            else:\n"
+        "                saved_topo, mismatch = None, False"))
+    target = tmp_path / "checkpoint_copy.py"
+    target.write_text(injected)
+    proc = _run_cli("--rules", "collective-order", str(target))
+    assert proc.returncode == 1, proc.stdout
+    line = [ln for ln in proc.stdout.splitlines()
+            if "collective-order" in ln][0]
+    assert "broadcast_one_to_all" in line
+    assert "jax.process_index()" in line
+    assert "_topology_verdict" in line and "chain:" in line
+    # the unmodified restore path is clean even standalone (no
+    # baseline, no suppression needed)
+    clean = tmp_path / "checkpoint_clean.py"
+    clean.write_text(src)
+    assert _run_cli("--rules", "collective-order",
+                    str(clean)).returncode == 0
+
+
 def test_acceptance_np_random_in_loader_substitution(tmp_path):
     """Reverse direction 2: an np.random draw injected into the loader
     substitution path → rc 1 naming rng-discipline."""

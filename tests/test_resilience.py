@@ -191,6 +191,57 @@ def test_manifests_written_after_commit(tmp_path):
     assert all("sha256" in e for e in manifest["files"].values())
 
 
+def test_topology_manifest_lifecycle_prune_and_quarantine(tmp_path):
+    """Elastic topology (ISSUE 10): a manager constructed with a
+    topology descriptor persists it per step next to the integrity
+    manifest, prune drops it with the step, and quarantine removes it
+    alongside the integrity manifest."""
+    from eksml_tpu.utils import CheckpointManager
+
+    topo = {"mesh_shape": [8, 1], "mesh_axes": ["data", "model"],
+            "num_slices": 1, "strategy": "replicated",
+            "fsdp_axis_size": 1, "num_devices": 8, "process_count": 1}
+    ckpt = CheckpointManager(str(tmp_path / "run"), topology=topo)
+    state = {"w": jnp.arange(8, dtype=jnp.float32)}
+    for s in (1, 2, 3):
+        assert ckpt.save(s, state)
+    ckpt.wait()
+    for s in (1, 2, 3):
+        assert integrity.read_topology_manifest(
+            ckpt.directory, s) is not None
+    # prune follows the integrity manifests
+    integrity.prune_manifests(ckpt.directory, keep_steps=[2, 3])
+    assert integrity.read_topology_manifest(ckpt.directory, 1) is None
+    assert integrity.read_topology_manifest(
+        ckpt.directory, 2) is not None
+    # quarantine drops the step's topology manifest with it
+    integrity.quarantine_step(ckpt.directory, 3)
+    assert integrity.read_topology_manifest(ckpt.directory, 3) is None
+    assert not os.path.exists(
+        integrity.topology_manifest_path(ckpt.directory, 3))
+    ckpt.close()
+
+
+def test_manifestless_checkpoint_restores_without_topology(tmp_path):
+    """Back-compat: a manager WITHOUT a topology descriptor (library
+    consumers) writes no topology manifest, and a topology-aware
+    manager restores a pre-elastic checkpoint (no manifest = no
+    evidence = no mismatch) without resharding or raising."""
+    from eksml_tpu.utils import CheckpointManager
+
+    ckpt, state = _save_steps(tmp_path)  # no topology passed
+    assert not os.path.exists(
+        integrity.topology_manifest_path(ckpt.directory, 3))
+    ckpt.close()
+    topo = {"mesh_shape": [8, 1], "mesh_axes": ["data", "model"],
+            "num_slices": 1, "strategy": "replicated",
+            "fsdp_axis_size": 1, "num_devices": 8, "process_count": 1}
+    aware = CheckpointManager(str(tmp_path / "run"), topology=topo)
+    out, step = aware.restore_with_fallback(state)
+    assert step == 3 and float(out["w"][0]) == float(state["w"][0])
+    aware.close()
+
+
 def test_truncated_file_fails_verification(tmp_path):
     ckpt, _ = _save_steps(tmp_path)
     victim = _step_files(ckpt, 3)[0]

@@ -84,6 +84,34 @@ def test_report_renders_from_dryrun_artifacts(tmp_path):
     assert "| backbone | 41.5 |" in report
 
 
+def test_report_elastic_section_renders_reshards(tmp_path):
+    """Elastic resume (ISSUE 10): ``checkpoint_resharded`` events —
+    recorded through the real FlightRecorder — render as the
+    saved→current table; a logdir without any degrades to the knob
+    pointer."""
+    logdir = str(tmp_path / "run")
+    rec = telemetry.FlightRecorder(
+        path=telemetry.events_path_for(logdir, 0))
+    rec.record("checkpoint_restore", step=4)
+    rec.record("checkpoint_resharded", step=4,
+               saved="mesh [1, 8, 1] over ['data', 'fsdp', 'model']",
+               current="mesh [2, 4, 1] over ['data', 'fsdp', 'model']",
+               diff="mesh_shape: [1, 8, 1] -> [2, 4, 1]; "
+                    "fsdp_axis_size: 8 -> 4")
+    rec.close()
+    report = run_report.render_report(logdir)
+    assert "## Elastic resume (topology changes)" in report
+    assert "1 resharded restore(s)" in report
+    assert "fsdp_axis_size: 8 -> 4" in report
+    assert ("Latest crossing: saved on mesh [1, 8, 1]" in report
+            and "restored onto mesh [2, 4, 1]" in report)
+
+    # absence degrades to a pointer naming the knob, never an error
+    report = run_report.render_report(str(tmp_path / "empty"))
+    assert "No `checkpoint_resharded` events" in report
+    assert "RESILIENCE.ELASTIC_RESUME" in report
+
+
 def test_report_cli_writes_file(tmp_path):
     logdir = str(tmp_path / "run")
     _dryrun_artifacts(logdir)

@@ -10,6 +10,12 @@ Trainer pair — ``fsdp`` losses must match ``replicated`` losses across
 to ≤ 1/4, and a sharded checkpoint must round-trip
 sharded → replicated → sharded, including the alternate-layout restore
 fallback.
+
+Elastic topology half (ISSUE 10): the topology-manifest schema
+round-trip, the fsdp 8 → 4 → 2 → 8 restore ladder (every hop a
+resharded topology change, params bit-exact, bytes-per-device and
+loss parity asserted), the reshard-vs-native-resume bit-identity, and
+the ``RESILIENCE.ELASTIC_RESUME=False`` fail-fast contract.
 """
 
 import os
@@ -244,12 +250,14 @@ def test_bytes_per_device_counts_shards():
 # ---- Trainer integration: parity, gauges, checkpoint round-trip -----
 
 
-def _trainer(tmp, strategy, seed_cfg):
+def _trainer(tmp, strategy, seed_cfg, fsdp=0, elastic=True):
     from eksml_tpu.train import Trainer
 
     cfg = seed_cfg.clone()
     cfg.freeze(False)
     cfg.TRAIN.SHARDING.STRATEGY = strategy
+    cfg.TRAIN.SHARDING.FSDP_AXIS_SIZE = fsdp
+    cfg.RESILIENCE.ELASTIC_RESUME = elastic
     cfg.TRAIN.LOGDIR = str(tmp)
     cfg.freeze()
     return Trainer(cfg, cfg.TRAIN.LOGDIR, write_metrics=False)
@@ -411,6 +419,190 @@ def test_restore_falls_back_to_alternate_layout(trainer_runs,
     assert any("fsdp" in str(l.sharding.spec)
                for l in jax.tree.leaves(state.params))
     _assert_states_close(state.params, want.params)
+
+
+# ---- elastic topology: manifests + cross-axis restores (ISSUE 10) ---
+
+
+def _resharded_count():
+    from eksml_tpu import telemetry
+
+    m = telemetry.default_registry().get(
+        "eksml_checkpoint_restore_resharded")
+    return float(m.value) if m is not None else 0.0
+
+
+def _seed_fsdp8_checkpoint(tmp, cfg, state, step=5):
+    """A clean logdir holding ONE fsdp(8) checkpoint + its topology
+    manifest (decoupled from whatever later steps other tests commit
+    into the shared trainer_runs logdirs)."""
+    tr = _trainer(tmp, "fsdp", cfg)
+    tr.ckpt.save(step, state)
+    tr.ckpt.wait()
+    tr.ckpt.close()
+    from eksml_tpu.resilience import integrity
+
+    saved = integrity.read_topology_manifest(
+        str(tmp) + "/checkpoints", step)
+    assert saved is not None and saved["fsdp_axis_size"] == 8
+    return saved
+
+
+def test_topology_manifest_schema_roundtrip(tmp_path):
+    """The descriptor schema: field set, write→read round-trip,
+    compatibility verdicts, changed-fields-only diff, and tolerant
+    load of torn / future-version manifests."""
+    from eksml_tpu.parallel import current_topology
+    from eksml_tpu.parallel import topology as topo
+    from eksml_tpu.resilience import integrity
+
+    mesh8, mesh4 = _mesh((1, 8, 1)), _mesh((2, 4, 1))
+    t8 = current_topology(mesh8, ShardingPlan("fsdp", mesh8),
+                          num_slices=1)
+    t4 = current_topology(mesh4, ShardingPlan("fsdp", mesh4),
+                          num_slices=1)
+    assert tuple(t8) == topo.FIELDS  # schema = the field inventory
+    root = str(tmp_path)
+    integrity.write_topology_manifest(root, 5, t8)
+    back = integrity.read_topology_manifest(root, 5)
+    assert back == topo.normalize(t8)
+    assert topo.compatible(back, t8)
+    assert not topo.compatible(back, t4)
+    # the diff names ONLY the changed fields
+    d = topo.diff(t8, t4)
+    assert "mesh_shape" in d and "fsdp_axis_size" in d
+    assert "num_devices" not in d and "strategy" not in d
+    # tolerant load: unknown version / torn file = "no evidence"
+    path = integrity.topology_manifest_path(root, 5)
+    open(path, "w").write('{"version": 999, "topology": {}}')
+    assert integrity.read_topology_manifest(root, 5) is None
+    open(path, "w").write("{ torn")
+    assert integrity.read_topology_manifest(root, 5) is None
+    # absence is compatible: pre-elastic checkpoints must restore —
+    # both a whole missing descriptor and PER-FIELD absence (a
+    # version-1 manifest from before a field joined FIELDS must not
+    # make every old checkpoint read as a different topology)
+    assert topo.compatible(None, t8) and topo.compatible(t8, None)
+    partial = {k: v for k, v in topo.normalize(t8).items()
+               if k != "process_count"}
+    assert topo.compatible(partial, t8)
+    assert "process_count" not in topo.diff(partial, t8)
+    assert topo.compatible({}, t8)  # an empty payload is no evidence
+
+
+def test_elastic_restore_across_fsdp_axis_ladder(trainer_runs,
+                                                 tmp_path):
+    """The acceptance ladder: an fsdp(8) checkpoint restores on
+    fsdp(4), its re-save on fsdp(2), and THAT re-save back on fsdp(8)
+    — every hop a topology change (mesh shape + axis size differ),
+    every hop resharded (counter + event), params bit-exact
+    throughout, per-device bytes tracking the axis size, and the
+    post-restore loss at parity with the fsdp(8) reference."""
+    cfg = trainer_runs["cfg"]
+    want = trainer_runs["fsdp"]["state"]
+    batch0 = _batches(cfg, 1)[0]
+    ladder = str(tmp_path / "ladder")
+    _seed_fsdp8_checkpoint(ladder, cfg, want)
+
+    ref_tr = trainer_runs["fsdp"]["trainer"]
+    ref_loss = float(np.asarray(ref_tr.compiled_step()(
+        want, ref_tr._globalize_batch(batch0))[1]["total_loss"]))
+
+    step = 5
+    bytes_by_axis = {8: tree_bytes_per_device(want.params)}
+    for axis in (4, 2, 8):
+        before = _resharded_count()
+        tr = _trainer(ladder, "fsdp", cfg, fsdp=axis)
+        state, start = tr.restore_or_init(
+            tr._globalize_batch(batch0))
+        assert start == step
+        assert _resharded_count() == before + 1, (
+            f"hop to fsdp({axis}) must record a resharded restore")
+        _assert_states_close(state.params, want.params)  # bit-exact
+        bytes_by_axis[axis] = tree_bytes_per_device(state.params)
+        # loss parity from the restored state under the new layout
+        loss = float(np.asarray(tr.compiled_step()(
+            state, tr._globalize_batch(batch0))[1]["total_loss"]))
+        np.testing.assert_allclose(loss, ref_loss, atol=1e-4)
+        step += 1
+        tr.ckpt.save(step, state)
+        tr.ckpt.wait()
+        tr.ckpt.close()
+    # per-device bytes scale with the axis: halving the axis roughly
+    # doubles the shardable bytes, and the final fsdp(8) restore costs
+    # exactly what the original fsdp(8) state did
+    assert bytes_by_axis[2] > bytes_by_axis[4] > bytes_by_axis[8]
+    assert bytes_by_axis[8] == tree_bytes_per_device(want.params)
+
+
+def test_elastic_restore_matches_same_topology_resume(trainer_runs,
+                                                      tmp_path):
+    """The acceptance bit-identity: resuming an fsdp(8) checkpoint on
+    an fsdp(4) trainer (elastic reshard) continues with EXACTLY the
+    loss stream a same-topology fsdp(4) resume of the same bytes
+    produces — the reshard moved bytes, it computed nothing."""
+    import jax as _jax
+
+    cfg = trainer_runs["cfg"]
+    want = trainer_runs["fsdp"]["state"]
+    batch0 = _batches(cfg, 1)[0]
+    elastic_dir = str(tmp_path / "elastic")
+    _seed_fsdp8_checkpoint(elastic_dir, cfg, want)
+
+    # elastic: fsdp(8) checkpoint restored by an fsdp(4) trainer
+    tr_e = _trainer(elastic_dir, "fsdp", cfg, fsdp=4)
+    state_e, start = tr_e.restore_or_init(tr_e._globalize_batch(batch0))
+    assert start == 5
+
+    # control: the SAME bytes committed natively at fsdp(4), resumed
+    # same-topology (no reshard event)
+    native_dir = str(tmp_path / "native")
+    tr_n = _trainer(native_dir, "fsdp", cfg, fsdp=4)
+    tr_n.ckpt.save(5, state_e)
+    tr_n.ckpt.wait()
+    tr_n.ckpt.close()
+    before = _resharded_count()
+    tr_c = _trainer(native_dir, "fsdp", cfg, fsdp=4)
+    state_c, start = tr_c.restore_or_init(tr_c._globalize_batch(batch0))
+    assert start == 5
+    assert _resharded_count() == before, (
+        "a same-topology resume must NOT count as resharded")
+
+    # restored states are bit-identical...
+    for a, b in zip(_jax.tree.leaves(state_e), _jax.tree.leaves(state_c)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # ...and so are the continued loss streams, step for step
+    step_e, step_c = tr_e.compiled_step(), tr_c.compiled_step()
+    for b in _batches(cfg, 3):
+        state_e, me = step_e(state_e, tr_e._globalize_batch(b))
+        state_c, mc = step_c(state_c, tr_c._globalize_batch(b))
+        assert float(np.asarray(me["total_loss"])) == float(
+            np.asarray(mc["total_loss"]))
+    tr_e.ckpt.close()
+    tr_c.ckpt.close()
+
+
+def test_elastic_disabled_topology_mismatch_fails_fast(trainer_runs,
+                                                       tmp_path):
+    """Acceptance: with RESILIENCE.ELASTIC_RESUME=False a
+    topology-mismatched restore fails BEFORE any deserialization, with
+    an actionable message naming the knob and the saved→current diff —
+    and quarantines nothing."""
+    cfg = trainer_runs["cfg"]
+    logdir = str(tmp_path / "noelastic")
+    _seed_fsdp8_checkpoint(logdir, cfg, trainer_runs["fsdp"]["state"])
+    tr = _trainer(logdir, "fsdp", cfg, fsdp=2, elastic=False)
+    with pytest.raises(RuntimeError) as e:
+        tr.restore_or_init(tr._globalize_batch(_batches(cfg, 1)[0]))
+    tr.ckpt.close()
+    msg = str(e.value)
+    assert "RESILIENCE.ELASTIC_RESUME" in msg
+    assert "different topology" in msg
+    assert "fsdp_axis_size: 8 -> 2" in msg
+    # fail-fast, not quarantine: the checkpoint is untouched
+    ckpt_dir = os.path.join(logdir, "checkpoints")
+    assert "5" in os.listdir(ckpt_dir)
+    assert not [p for p in os.listdir(ckpt_dir) if "corrupt" in p]
 
 
 @pytest.mark.slow

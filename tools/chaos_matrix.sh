@@ -18,6 +18,11 @@
 # real tree via tests/test_lint.py — the framework-invariant static
 # gate (jit purity, post-override config drift, signal-handler
 # safety, atomic writes, scope coverage, chart/values sync).
+# proc-elastic-resume drives the elastic-topology subsystem (ISSUE
+# 10): SIGTERM at 8 fake chips fsdp(8), relaunch at 4 chips fsdp(4)
+# (same global batch), grow back to 8 — each crossing must reshard
+# the restore (checkpoint_resharded event + saved→current diff) and
+# continue the loss stream from the forced checkpoint.
 # unit-lint-spmd runs the v2 cross-module SPMD rules (ISSUE 9:
 # collective-order, rng-discipline, host-sync, recompile-hazard) over
 # fixtures AND the real tree; proc-spmd-collective-skip is the
@@ -59,6 +64,7 @@ RUNGS=(
   "data-broken-pool|tests/test_fault_tolerance.py::test_broken_pool_rebuilds_and_continues"
   "proc-sigkill-resume|tests/test_fault_tolerance.py::test_sigkill_then_resume"
   "proc-sigterm-graceful|tests/test_fault_tolerance.py::test_sigterm_graceful_preempt_then_resume"
+  "proc-elastic-resume|tests/test_fault_tolerance.py::test_elastic_resume_grow_shrink"
   "proc-corrupt-latest|tests/test_fault_tolerance.py::test_corrupt_latest_checkpoint_falls_back"
   "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"
   "proc-debugz-profile|tests/test_fault_tolerance.py::test_debugz_profile_capture_midrun_with_tracing"

@@ -16,6 +16,10 @@ tool folds them into one reviewable report:
   time-ordered — the SIGTERM → forced save → resumable exit chain, a
   NaN streak → rollback → restore chain, quarantines, pool rebuilds,
   watchdog dumps.
+- **Elastic resume**: every ``checkpoint_resharded`` event — a
+  restore that crossed topologies (grow/shrink relaunch) — with its
+  saved→current diff; degrades to a pointer at the
+  ``RESILIENCE.ELASTIC_RESUME`` knob when the run never resharded.
 - **Non-finite observations**: rows whose scalars were sanitized to
   ``null`` (the ``*_raw_repr`` satellite), i.e. exactly where the loss
   went bad.
@@ -213,6 +217,42 @@ def _events_section(events: List[Dict], max_events: int) -> List[str]:
               "By kind: " + ", ".join(
                   f"{k}×{n}" for k, n in sorted(counts.items(),
                                                 key=lambda kv: -kv[1]))]
+    return lines
+
+
+def _elastic_section(events: List[Dict]) -> List[str]:
+    """Topology-crossing restores (elastic resume, ROADMAP item 4):
+    every ``checkpoint_resharded`` event with its saved→current diff,
+    degrading to a pointer when the run never crossed a topology."""
+    lines = ["## Elastic resume (topology changes)"]
+    resharded = [e for e in events
+                 if e.get("kind") == "checkpoint_resharded"]
+    if not resharded:
+        lines += ["", "No `checkpoint_resharded` events — every "
+                      "restore (if any) matched the topology it was "
+                      "saved at.  Topology-portable restore is "
+                      "governed by `RESILIENCE.ELASTIC_RESUME` "
+                      "(eksml_tpu/utils/checkpoint.py; per-step "
+                      "topology manifests under "
+                      "`checkpoints/.integrity/`)."]
+        return lines
+    lines += ["",
+              f"{len(resharded)} resharded restore(s) — the run "
+              "crossed topologies and resumed in place:",
+              "",
+              "| time | host | step | saved -> current |",
+              "|---|---|---|---|"]
+    for e in resharded:
+        detail = e.get("diff") or f"{e.get('saved', '?')} -> " \
+                                  f"{e.get('current', '?')}"
+        lines.append(
+            f"| {_ts(e.get('time'))} | {e.get('host', '-')} "
+            f"| {e.get('step', '-')} | {detail} |")
+    # full descriptors for the LATEST crossing only — labeled as such
+    # (a grow-after-shrink run has several, all in the table above)
+    lines += ["",
+              f"Latest crossing: saved on {resharded[-1].get('saved', '?')}; "
+              f"restored onto {resharded[-1].get('current', '?')}."]
     return lines
 
 
@@ -427,6 +467,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
         lines.extend(_segment_section(i, seg))
     lines.append("")
     lines.extend(_events_section(events, max_events))
+    lines.append("")
+    lines.extend(_elastic_section(events))
     lines.append("")
     lines.extend(_slow_steps_section(logdir))
     lines.append("")
