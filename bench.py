@@ -181,7 +181,8 @@ def _init_devices(retries: int, backoff: float, attempt_timeout: float):
     import jax
 
     last = None
-    pool = ThreadPoolExecutor(max_workers=retries)
+    pool = ThreadPoolExecutor(max_workers=retries,
+                              thread_name_prefix="bench-init")
     for attempt in range(retries):
         try:
             return pool.submit(jax.devices).result(timeout=attempt_timeout)

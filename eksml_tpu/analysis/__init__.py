@@ -42,11 +42,31 @@ violations the runtime layers can only diagnose post-mortem:
   ``.shape[i]``, per-batch dict keys) must not feed jitted callables
   outside the bucketed static-shape schedule
 
+v3 (ISSUE 12) adds thread-topology concurrency analysis
+(:mod:`.concurrency`): a thread-root inventory (``Thread(target=…)``,
+executor ``submit``/``map`` callees, ``BaseHTTPRequestHandler``
+``do_*`` methods, signal handlers, ``atexit`` hooks, the main-thread
+entry points) and a lock inventory (attrs/globals assigned from
+``threading.Lock/RLock/Condition``) feed a shared reachability walk
+that carries held locks across call edges, powering three rules:
+
+- ``lock-order``           — the combined lock-acquisition-order
+  graph over all thread roots must be acyclic; a cycle is a
+  potential deadlock, reported with every edge's root→acquire chain
+- ``unlocked-shared-state``— an attribute mutated from ≥2 thread
+  roots whose locksets share no common lock (Eraser's lockset
+  intersection going empty); constructor paths are exempt
+- ``blocking-under-lock``  — an unbounded blocking call
+  (``get``/``join``/``wait`` without timeout, socket/HTTP, jax
+  collectives, subprocess waits) while holding a lock another root
+  also acquires
+
 Entry point: ``tools/eksml_lint.py`` (JSON + human output — findings
 carry the root→collective ``chain`` — committed baseline,
 ``# eksml-lint: disable=<rule>`` suppressions, ``--changed`` fast
 pre-commit scope, nonzero exit on any non-baselined finding — a
-tier-1 gate via tests/test_lint.py + tests/test_lint_spmd.py).
+tier-1 gate via tests/test_lint.py + tests/test_lint_spmd.py +
+tests/test_lint_concurrency.py).
 """
 
 from eksml_tpu.analysis.engine import (  # noqa: F401

@@ -24,6 +24,8 @@ import os
 import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from eksml_tpu.analysis.concurrency import (CONCURRENCY_RULES,
+                                            build_concurrency_checkers)
 from eksml_tpu.analysis.engine import Finding, ModuleInfo
 from eksml_tpu.analysis.graph import (FuncInfo, ProjectGraph,
                                       chain_of as _chain,
@@ -38,7 +40,7 @@ RULE_SCOPE = "scope-coverage"
 RULE_VALUES = "values-config-sync"
 
 ALL_RULES = (RULE_JIT, RULE_DRIFT, RULE_SIGNAL, RULE_ATOMIC,
-             RULE_SCOPE, RULE_VALUES) + SPMD_RULES
+             RULE_SCOPE, RULE_VALUES) + SPMD_RULES + CONCURRENCY_RULES
 
 
 # -- 1. jit-purity ----------------------------------------------------
@@ -746,6 +748,7 @@ def build_checkers(rules: Optional[Sequence[str]] = None):
     module_checkers = [ConfigDriftChecker(), AtomicWriteChecker()]
     graph_checkers = [JitPurityChecker(), SignalSafetyChecker()]
     graph_checkers += build_spmd_checkers()
+    graph_checkers += build_concurrency_checkers()
     project_checkers = [ScopeCoverageChecker(),
                         ValuesConfigSyncChecker()]
     if rules is not None:

@@ -497,6 +497,25 @@ class ProjectGraph:
         self._calls_cache[key] = out
         return out
 
+    def nested_defs(self, fi: FuncInfo) -> List[FuncInfo]:
+        """Functions defined directly inside *fi* (closures/workers)."""
+        return list(self._children.get(id(fi.node), ()))
+
+    def class_method(self, path: str, cls: Optional[str],
+                     name: str) -> Optional[FuncInfo]:
+        """Method *name* of class *cls* in *path*, if both exist."""
+        if cls is None:
+            return None
+        return self._classes.get(path, {}).get(cls, {}).get(name)
+
+    def class_bases(self, path: str, cls: str) -> List[ast.expr]:
+        """Base-class expressions of a ClassDef (for checkers that
+        classify subclass trees, e.g. BaseHTTPRequestHandler do_*)."""
+        for node in ast.walk(self.mods[path].tree):
+            if isinstance(node, ast.ClassDef) and node.name == cls:
+                return list(node.bases)
+        return []
+
     def reachable(self, roots: Iterable[FuncInfo],
                   unique_fallback: bool = False,
                   stop_names: Iterable[str] = ()

@@ -441,12 +441,27 @@ class DetectionLoader:
             if not self._pool_break_pending:
                 return
             self._pool_break_pending = False
-        old, self._proc_pool = self._proc_pool, None
+            # swap AND rebuild under the same lock the consumer's
+            # teardown path takes (lint: unlocked-shared-state, first
+            # whole-repo run).  The rebuild must stay inside the
+            # critical section too: released between swap and
+            # install, a concurrent teardown could complete in the
+            # gap and the heal would install a live pool on a
+            # torn-down loader with nothing left to shut it down.
+            # Constructing the executor spawns no worker processes
+            # until the first submit, so this holds the lock for
+            # microseconds, not a pool start-up.
+            old, self._proc_pool = self._proc_pool, None
+            rebuilt = False
+            if self._pool_rebuilds_left > 0:
+                self._pool_rebuilds_left -= 1
+                self._proc_pool = self._make_proc_pool()
+                rebuilt = True
+            else:
+                self._pool_degraded = True  # no resurrection later
         if old is not None:
             old.shutdown(wait=False, cancel_futures=True)
-        if self._pool_rebuilds_left > 0:
-            self._pool_rebuilds_left -= 1
-            self._proc_pool = self._make_proc_pool()
+        if rebuilt:
             self.health.note_pool_rebuild()
             telemetry.default_registry().counter(
                 "eksml_data_pool_rebuilds",
@@ -456,7 +471,6 @@ class DetectionLoader:
             log.warning("decode process pool rebuilt (%d rebuild(s) "
                         "left)", self._pool_rebuilds_left)
         else:
-            self._pool_degraded = True  # no resurrection on re-iterate
             telemetry.event("pool_degraded")
             log.warning(
                 "decode pool rebuild budget exhausted (RESILIENCE."
@@ -632,7 +646,8 @@ class DetectionLoader:
         if (self.worker_processes > 0 and self._proc_pool is None
                 and not self._pool_degraded
                 and any(r.get("_image") is None for r in self.records)):
-            self._proc_pool = self._make_proc_pool()
+            with self._pool_lock:  # same discipline as the heal path
+                self._proc_pool = self._make_proc_pool()
 
         from eksml_tpu.data.coco import load_image
 
@@ -703,7 +718,8 @@ class DetectionLoader:
             finally:
                 put_or_stop(None)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="loader-producer")
         self.health.queue_depth = q.qsize
         self.health.producer_alive = t.is_alive
         t.start()
@@ -751,14 +767,18 @@ class DetectionLoader:
             t.join(timeout=5.0)
             if pool is not None:
                 pool.shutdown(wait=False)
-            if self._proc_pool is not None:
-                self._proc_pool.shutdown(wait=False, cancel_futures=True)
-                self._proc_pool = None
-            # the incident died with that pool: a stale flag would make
-            # the next batches() call tear down its fresh pool and
-            # silently burn the rebuild budget
             with self._pool_lock:
+                # pool handle swapped under the heal path's lock: the
+                # producer can outlive the 5 s join timeout above, and
+                # an unsynchronized teardown could null the handle a
+                # concurrent heal just rebuilt.  The stale break flag
+                # dies with the pool too: left set, the next batches()
+                # call would tear down its fresh pool and silently
+                # burn the rebuild budget
+                stale, self._proc_pool = self._proc_pool, None
                 self._pool_break_pending = False
+            if stale is not None:
+                stale.shutdown(wait=False, cancel_futures=True)
             # drop the dead pipeline's closures: keeping q.qsize /
             # t.is_alive bound would pin up to `prefetch` full batches
             # in memory and feed the watchdog stale state

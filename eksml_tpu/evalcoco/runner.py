@@ -257,8 +257,11 @@ def run_evaluation(model, params, cfg, records: List[Dict],
     max_pending = max(post_workers, 2 * batch_size)
     pending: List = []
     host_dets = []
-    with ThreadPoolExecutor(max_workers=1) as pool, \
-            ThreadPoolExecutor(max_workers=post_workers) as post_pool:
+    with ThreadPoolExecutor(max_workers=1,
+                            thread_name_prefix="eval-batch") as pool, \
+            ThreadPoolExecutor(max_workers=post_workers,
+                               thread_name_prefix="eval-post"
+                               ) as post_pool:
         nxt = pool.submit(build_batch, 0) if n_batches else None
         for b in range(n_batches):
             images, hw, scales, ids = nxt.result()

@@ -1006,3 +1006,122 @@ def test_rank_conditional_collective_skip_hangs_and_lints(tmp_path):
                 p.wait(timeout=30)
         for f_ in files:
             f_.close()
+
+
+# ---- rung 12: lock-order inversion (ISSUE 12) ------------------------
+
+LOCK_INVERSION_WORKER = r"""
+import sys
+import threading
+import time
+
+A = threading.Lock()
+B = threading.Lock()
+first_held = threading.Barrier(2, timeout=30)
+
+
+def w_ab():
+    with A:
+        first_held.wait()   # both threads hold their FIRST lock
+        with B:             # LINT: lock-order (A -> B here, B -> A below)
+            pass
+    print("w_ab DONE", flush=True)
+
+
+def w_ba():
+    with B:
+        first_held.wait()
+        with A:             # LINT: lock-order (the inverse order)
+            pass
+    print("w_ba DONE", flush=True)
+
+
+t1 = threading.Thread(target=w_ab, name="worker-ab")
+t2 = threading.Thread(target=w_ba, name="worker-ba")
+t1.start()
+t2.start()
+# the barrier guarantees BOTH threads sit between their first and
+# second acquisition — from here the deadlock is certain, not a race
+time.sleep(0.2)
+print("BOTH HOLDING", flush=True)
+t1.join()
+t2.join()
+print("ALL DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_lock_inversion_wedges_and_lints(tmp_path):
+    """ISSUE 12: the eksml-lint v3 ``lock-order`` finding and the
+    two-thread wedge are the same bug, proven once (the PR 9
+    pattern).  The worker takes A→B on one thread and B→A on the
+    other, with a barrier forcing both to sit between their first and
+    second acquisition — a certain deadlock, not a race.  The SAME
+    source, linted, yields a lock-order finding whose two chains name
+    the two inner ``with`` lines."""
+    worker_py = tmp_path / "inversion_worker.py"
+    worker_py.write_text(LOCK_INVERSION_WORKER)
+
+    # -- static half: the worker source is a finding ------------------
+    from eksml_tpu.analysis import run_lint
+
+    r = run_lint(targets=[str(worker_py)], repo_root=str(tmp_path),
+                 rules=["lock-order"])
+    assert len(r.findings) == 1, r.findings
+    f = r.findings[0]
+    assert "inversion_worker.A" in f.message
+    assert "inversion_worker.B" in f.message
+    lines = LOCK_INVERSION_WORKER.splitlines()
+    ab_line = next(i for i, ln in enumerate(lines, start=1)
+                   if "with B:             # LINT" in ln)
+    ba_line = next(i for i, ln in enumerate(lines, start=1)
+                   if "with A:             # LINT" in ln)
+    # both acquisition chains, each at its inner-with file:line
+    assert f"inversion_worker.py:{ab_line}" in f.message
+    assert f"inversion_worker.py:{ba_line}" in f.message
+    assert f.line in (ab_line, ba_line)
+    chain_lines = {c["line"] for c in f.chain}
+    assert {ab_line, ba_line} <= chain_lines
+
+    # -- runtime half: the same construct wedges two real threads -----
+    proc = subprocess.Popen([sys.executable, str(worker_py)],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        try:
+            out, _ = proc.communicate(timeout=20)
+            wedged = False
+        except subprocess.TimeoutExpired:
+            wedged = True
+        assert wedged, f"expected a deadlock, worker exited:\n{out}"
+    finally:
+        proc.kill()
+        out, _ = proc.communicate(timeout=30)
+    # both threads got their first lock and neither finished: the
+    # wedge is INSIDE the inverted second acquisition
+    assert "BOTH HOLDING" in out, out
+    assert "w_ab DONE" not in out and "w_ba DONE" not in out, out
+    assert "ALL DONE" not in out, out
+
+    # fixed ordering (B→A rewritten to A→B) exits cleanly AND lints
+    # clean: one bug, one fix, both halves agree
+    fixed = LOCK_INVERSION_WORKER.replace(
+        "    with B:\n        first_held.wait()\n"
+        "        with A:             # LINT: lock-order (the inverse "
+        "order)",
+        "    with A:\n        first_held.wait()\n"
+        "        with B:             # fixed: the one global order")
+    assert fixed != LOCK_INVERSION_WORKER
+    # with one global order the threads serialize on A, so the
+    # both-hold-their-first-lock barrier can never fill — drop it
+    fixed = fixed.replace("first_held.wait()",
+                          "pass  # no interleave to force")
+    fixed_py = tmp_path / "fixed_worker.py"
+    fixed_py.write_text(fixed)
+    r2 = run_lint(targets=[str(fixed_py)], repo_root=str(tmp_path),
+                  rules=["lock-order"])
+    assert r2.findings == [], r2.findings
+    done = subprocess.run([sys.executable, str(fixed_py)],
+                          capture_output=True, text=True, timeout=60)
+    assert done.returncode == 0, done.stdout + done.stderr
+    assert "ALL DONE" in done.stdout

@@ -196,3 +196,62 @@ def test_hang_static_crosslink_section(tmp_path, monkeypatch):
     text = run_report.render_report(str(tmp_path))
     assert "eksml_tpu/train.py:7" in text
     assert "**yes**" in text
+
+
+def test_concurrency_crosslink_section(tmp_path, monkeypatch):
+    """ISSUE 12: the newest hang report's stalled THREAD STACKS are
+    matched against lock-order/blocking-under-lock chains; without
+    reports the section degrades to a pointer; with a clean tree it
+    says the hang is not the thread-topology class; a finding whose
+    chain touches a stalled frame is marked."""
+    from tools import run_report
+
+    # no hang report → pointer naming the on-demand audit command
+    text = run_report.render_report(str(tmp_path))
+    assert "Concurrency cross-link" in text
+    assert "lock-order,blocking-under-lock" in text
+
+    (tmp_path / "hang_report_9_1.txt").write_text(
+        "eksml_tpu hang watchdog report #1\n"
+        "stalled phase: next_batch\nstep: 12\n\n"
+        "--- thread loader-producer (ident=1, daemon=True) ---\n"
+        '  File "/app/eksml_tpu/data/loader.py", line 444, '
+        "in _heal_proc_pool\n"
+        "    old.shutdown(wait=False)\n")
+    # clean tree → explicit "not the thread-topology class"
+    text = run_report.render_report(str(tmp_path))
+    assert "1 hang report(s)" in text
+    assert "1 stalled stack frame(s)" in text
+    assert "not the statically-checkable thread-topology class" in text
+
+    class _F:
+        rule = "blocking-under-lock"
+        path, line = "eksml_tpu/data/loader.py", 444
+        chain = [
+            {"path": "eksml_tpu/data/loader.py", "line": 646,
+             "name": "DetectionLoader._heal_proc_pool"},
+            {"path": "eksml_tpu/data/loader.py", "line": 444,
+             "name": ".join() without timeout"},
+        ]
+
+    class _G:
+        rule = "lock-order"
+        path, line = "eksml_tpu/train.py", 7
+        chain = [{"path": "eksml_tpu/train.py", "line": 7,
+                  "name": "acquire Trainer._lock"}]
+
+    class _R:
+        findings, baselined = [_F(), _G()], []
+
+    import eksml_tpu.analysis as analysis
+
+    monkeypatch.setattr(analysis, "run_lint", lambda **kw: _R())
+    text = run_report.render_report(str(tmp_path))
+    # _F's chain names the stalled frame's function → yes; _G → no
+    row_f = [ln for ln in text.splitlines()
+             if "blocking-under-lock: eksml_tpu/data/loader.py:444"
+             in ln][0]
+    assert "**yes**" in row_f
+    row_g = [ln for ln in text.splitlines()
+             if "lock-order: eksml_tpu/train.py:7" in ln][0]
+    assert "**yes**" not in row_g

@@ -29,6 +29,12 @@
 # runtime counterpart: a rank-conditional collective skip on a real
 # 2-process mesh wedges/dies, and the SAME construct is flagged
 # statically — the lint finding and the hang are one bug, proven once.
+# unit-lint-concurrency runs the v3 thread-topology rules (ISSUE 12:
+# lock-order, unlocked-shared-state, blocking-under-lock) over
+# fixtures AND the real tree; proc-lock-inversion is their runtime
+# counterpart: a two-thread A→B / B→A lock inversion provably wedges
+# under a test timeout while the SAME source lints to the lock-order
+# finding at the same lines — again one bug, proven once.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # processes and are marked slow (excluded from tier-1); the unit and
 # data-* rungs run in seconds.  Everything runs under
@@ -58,6 +64,7 @@ RUNGS=(
   "unit-perfgate|tests/test_perf_gate.py"
   "unit-lint|tests/test_lint.py"
   "unit-lint-spmd|tests/test_lint_spmd.py"
+  "unit-lint-concurrency|tests/test_lint_concurrency.py"
   "data-corrupt-jpeg|'tests/test_fault_tolerance.py::test_data_fault_rung[corrupt-jpeg]'"
   "data-missing-file|'tests/test_fault_tolerance.py::test_data_fault_rung[missing-file]'"
   "data-eio-recover|'tests/test_fault_tolerance.py::test_data_fault_rung[eio-recover]'"
@@ -69,6 +76,7 @@ RUNGS=(
   "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"
   "proc-debugz-profile|tests/test_fault_tolerance.py::test_debugz_profile_capture_midrun_with_tracing"
   "proc-spmd-collective-skip|tests/test_fault_tolerance.py::test_rank_conditional_collective_skip_hangs_and_lints"
+  "proc-lock-inversion|tests/test_fault_tolerance.py::test_lock_inversion_wedges_and_lints"
   "proc-data-chaos|tests/test_fault_tolerance.py::test_data_chaos_train_completes_with_quarantine"
   "proc-data-breaker|tests/test_fault_tolerance.py::test_quarantine_overflow_aborts_actionably"
 )

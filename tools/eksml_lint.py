@@ -1,11 +1,14 @@
 """eksml-lint CLI: framework-invariant static analysis gating CI.
 
-Runs the six checkers in ``eksml_tpu/analysis/`` over the production
-tree (eksml_tpu/, tools/, bench.py — tests are excluded on purpose)
-and exits nonzero on any finding that is neither suppressed inline
-(``# eksml-lint: disable=<rule>``) nor grandfathered in the committed
-baseline.  tests/test_lint.py runs this over the real repo, which
-makes every invariant a tier-1 gate.
+Runs the thirteen rules in ``eksml_tpu/analysis/`` over the
+production tree (eksml_tpu/, tools/, bench.py — tests are excluded on
+purpose) and exits nonzero on any finding that is neither suppressed
+inline (``# eksml-lint: disable=<rule>``) nor grandfathered in the
+committed baseline: the six v1 module/project rules, the four v2
+SPMD-safety rules on the cross-module call graph, and the three v3
+thread-topology concurrency rules (lock-order, unlocked-shared-state,
+blocking-under-lock).  tests/test_lint.py runs this over the real
+repo, which makes every invariant a tier-1 gate.
 
 Usage::
 

@@ -32,6 +32,12 @@ tool folds them into one reviewable report:
   rule and any finding whose root→collective chain touches the
   stalled phase is flagged — the hang and the lint finding are the
   same divergence bug, proven once.
+- **Concurrency cross-link**: the newest hang report's all-thread
+  stalled stacks matched against eksml-lint v3's
+  ``lock-order``/``blocking-under-lock`` chains — a hang whose stack
+  sits inside a function a deadlock finding names is the
+  statically-predicted inversion observed live; degrades to a
+  pointer when no reports or findings exist.
 - **Modeled cost**: the attribution component table, when the run
   banked a profile.
 - **Predicted vs measured**: the perf-gate prediction bank
@@ -54,9 +60,10 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 def _read_jsonl(path: str) -> List[Dict]:
@@ -319,6 +326,36 @@ def _attribution_section(logdir: str,
     return lines
 
 
+def _hang_reports(logdir: str) -> List[str]:
+    """Hang reports newest-last by mtime: the names are
+    hang_report_<pid>_<fires>.txt, so a lexicographic sort is
+    arbitrary across restarts (pid order) and wraps within one
+    process at fires=10."""
+    return sorted(glob.glob(os.path.join(logdir, "hang_report_*.txt")),
+                  key=os.path.getmtime)
+
+
+def _scoped_lint(rules: List[str]):
+    """eksml-lint findings (incl. baselined) scoped to *rules*, or an
+    error string — the shared machinery of both cross-link sections.
+    Two scoped calls each rebuild the whole-program graph; acceptable
+    for a post-mortem tool that only lints when hang reports exist."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from eksml_tpu.analysis import run_lint
+
+        result = run_lint(rules=rules)
+        return list(result.findings) + list(result.baselined), None
+    except Exception as e:  # noqa: BLE001 — partial evidence is fine
+        return [], f"Static analysis unavailable: {e!r}"
+
+
+def _chain_str(fnd) -> str:
+    return " → ".join(f"{c['path']}:{c['line']} {c['name']}"
+                      for c in (fnd.chain or [])) or "-"
+
+
 def _hang_static_section(logdir: str) -> List[str]:
     """Cross-link a watchdog hang report to a matching static
     ``collective-order`` finding (eksml-lint v2).  The lint finding
@@ -328,12 +365,7 @@ def _hang_static_section(logdir: str) -> List[str]:
     name matches it, the report says so — post-mortem and prevention
     joined in one table."""
     lines = ["## Static SPMD cross-link (watchdog ↔ eksml-lint)"]
-    # newest by mtime: the names are hang_report_<pid>_<fires>.txt, so
-    # a lexicographic sort is arbitrary across restarts (pid order)
-    # and wraps within one process at fires=10
-    reports = sorted(glob.glob(os.path.join(logdir,
-                                            "hang_report_*.txt")),
-                     key=os.path.getmtime)
+    reports = _hang_reports(logdir)
     if not reports:
         lines += ["", "No watchdog hang reports in this logdir — "
                       "nothing to cross-link.  (`python "
@@ -352,15 +384,9 @@ def _hang_static_section(logdir: str) -> List[str]:
     lines += ["", f"{len(reports)} hang report(s); newest "
                   f"`{os.path.basename(reports[-1])}` stalled in "
                   f"phase `{phase or '?'}`."]
-    try:
-        sys.path.insert(0, os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        from eksml_tpu.analysis import run_lint
-
-        result = run_lint(rules=["collective-order"])
-        findings = list(result.findings) + list(result.baselined)
-    except Exception as e:  # noqa: BLE001 — partial evidence is fine
-        lines += ["", f"Static analysis unavailable: {e!r}"]
+    findings, err = _scoped_lint(["collective-order"])
+    if err:
+        lines += ["", err]
         return lines
     if not findings:
         lines += ["", "No static `collective-order` findings in the "
@@ -373,13 +399,83 @@ def _hang_static_section(logdir: str) -> List[str]:
     lines += ["", "| finding | chain | matches stalled phase |",
               "|---|---|---|"]
     for fnd in findings:
-        chain = fnd.chain or []
-        chain_s = " → ".join(f"{c['path']}:{c['line']} {c['name']}"
-                             for c in chain) or "-"
         hit = bool(phase) and any(
-            phase in c.get("name", "") for c in chain)
+            phase in c.get("name", "") for c in (fnd.chain or []))
         lines.append(f"| {fnd.path}:{fnd.line} "
-                     f"| {chain_s} | {'**yes**' if hit else 'no'} |")
+                     f"| {_chain_str(fnd)} "
+                     f"| {'**yes**' if hit else 'no'} |")
+    return lines
+
+
+def _stalled_stack_frames(report_path: str) -> List[Tuple[str, str, int]]:
+    """(function, file-basename, line) frames from a hang report's
+    all-thread stack section (``format_thread_stacks`` output:
+    ``File "<path>", line N, in <func>`` pairs under ``--- thread``
+    headers)."""
+    frames: List[Tuple[str, str, int]] = []
+    frame_re = re.compile(
+        r'File "(?P<path>[^"]+)", line (?P<line>\d+), '
+        r'in (?P<func>\S+)')
+    try:
+        with open(report_path) as f:
+            for ln in f:
+                m = frame_re.search(ln)
+                if m:
+                    frames.append((m.group("func"),
+                                   os.path.basename(m.group("path")),
+                                   int(m.group("line"))))
+    except OSError:
+        pass
+    return frames
+
+
+def _concurrency_section(logdir: str) -> List[str]:
+    """Cross-link a watchdog hang report's stalled THREAD STACKS to a
+    matching ``lock-order``/``blocking-under-lock`` finding (eksml-lint
+    v3) — the thread-topology companion of the SPMD cross-link above.
+    A hang whose stacks sit inside a function named by a concurrency
+    finding's chain is the statically-predicted deadlock observed
+    live.  Degrades to a pointer with no reports, and to an explicit
+    "not this class" note with a clean tree."""
+    lines = ["## Concurrency cross-link (watchdog ↔ eksml-lint v3)"]
+    reports = _hang_reports(logdir)
+    if not reports:
+        lines += ["", "No watchdog hang reports in this logdir — "
+                      "nothing to cross-link.  (`python "
+                      "tools/eksml_lint.py --rules lock-order,"
+                      "blocking-under-lock --json` audits the tree's "
+                      "thread topology on demand.)"]
+        return lines
+    frames = _stalled_stack_frames(reports[-1])
+    lines += ["", f"{len(reports)} hang report(s); newest "
+                  f"`{os.path.basename(reports[-1])}` carries "
+                  f"{len(frames)} stalled stack frame(s)."]
+    findings, err = _scoped_lint(["lock-order", "blocking-under-lock"])
+    if err:
+        lines += ["", err]
+        return lines
+    if not findings:
+        lines += ["", "No static `lock-order`/`blocking-under-lock` "
+                      "findings in the tree — this hang is not the "
+                      "statically-checkable thread-topology class "
+                      "(check the stalled stacks against the data-"
+                      "pipeline section; an external peer or a "
+                      "wedged collective are the usual suspects)."]
+        return lines
+    funcs = {f for f, _, _ in frames}
+    files_lines = {(b, n) for _, b, n in frames}
+    lines += ["", "| finding | chain | matches stalled stack |",
+              "|---|---|---|"]
+    for fnd in findings:
+        hit = any(
+            c.get("name", "").split()[-1].rsplit(".", 1)[-1] in funcs
+            or (os.path.basename(c.get("path", "")),
+                c.get("line")) in files_lines
+            for c in (fnd.chain or []))
+        rule = getattr(fnd, "rule", "?")
+        lines.append(f"| {rule}: {fnd.path}:{fnd.line} "
+                     f"| {_chain_str(fnd)} "
+                     f"| {'**yes**' if hit else 'no'} |")
     return lines
 
 
@@ -473,6 +569,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.extend(_slow_steps_section(logdir))
     lines.append("")
     lines.extend(_hang_static_section(logdir))
+    lines.append("")
+    lines.extend(_concurrency_section(logdir))
     lines.append("")
     lines.extend(_attribution_section(logdir, attribution))
     lines.append("")
