@@ -280,6 +280,25 @@ TELEMETRY_TRACING_DEFAULTS = dict(
     ANOMALY_SPREAD_FACTOR=1.5,
 )
 
+# Goodput-ledger knobs (telemetry/goodput.py), installed under
+# TELEMETRY.GOODPUT; train._goodput_knobs imports the same dict as
+# the fallback for pre-goodput config trees.
+#
+# - ENABLED: classify run wall-clock into goodput/badput buckets (fed
+#   by the span sink + flight-recorder sink — no new hot-path
+#   instrumentation) and publish eksml_goodput_ratio +
+#   eksml_badput_seconds_total{bucket=} via the exporter.  Rides the
+#   TELEMETRY.ENABLED master switch: off means off.
+# - BANK: append per-segment ledger snapshots to
+#   <logdir>/goodput-host<i>.jsonl at each log interval — the
+#   artifact tools/goodput_report.py merges ACROSS restarts (the
+#   in-process meter dies with the process; the bank is what makes
+#   the ledger whole-run).
+TELEMETRY_GOODPUT_DEFAULTS = dict(
+    ENABLED=True,
+    BANK=True,
+)
+
 
 def _define_defaults() -> None:
     # ---- mode flags (reference templates/maskrcnn.yaml:61-62) -------
@@ -518,6 +537,9 @@ def _define_defaults() -> None:
     # span tracing + on-demand profiling (telemetry/tracing.py)
     for k, v in TELEMETRY_TRACING_DEFAULTS.items():
         setattr(_C.TELEMETRY.TRACING, k, v)
+    # goodput/badput wall-clock ledger (telemetry/goodput.py)
+    for k, v in TELEMETRY_GOODPUT_DEFAULTS.items():
+        setattr(_C.TELEMETRY.GOODPUT, k, v)
 
     _C.freeze()
 

@@ -26,7 +26,11 @@ their own): ``sigterm``, ``preempt_exit``, ``nan_observed``,
 ``rollback``, ``quarantine``, ``pool_rebuild``, ``pool_degraded``,
 ``starvation``, ``watchdog_dump``, ``checkpoint_save``,
 ``checkpoint_skipped``, ``checkpoint_restore``,
-``checkpoint_fallback``, ``checkpoint_quarantined``, ``run_start``.
+``checkpoint_fallback``, ``checkpoint_quarantined``, ``run_start``,
+``compile_start``/``compile_done`` and ``eval_start``/``eval_done``
+(the phases the goodput ledger would otherwise misattribute to
+``host_overhead`` — the ``*_done`` events carry the measured
+duration).
 """
 
 from __future__ import annotations
@@ -100,6 +104,14 @@ class FlightRecorder:
             "eksml_flight_events",
             "flight-recorder events by kind",
             labels={"kind": str(kind)}).inc()
+        # event sinks (goodput ledger): notified OUTSIDE the ring lock
+        # — a sink must never extend the recorder's critical section,
+        # and a broken one must never cost the incident event
+        for sink in list(_event_sinks):
+            try:
+                sink(entry)
+            except Exception:  # noqa: BLE001 — observability only
+                log.exception("flight-event sink failed for %r", kind)
         return entry
 
     def tail(self, n: Optional[int] = None) -> List[Dict]:
@@ -139,7 +151,27 @@ class FlightRecorder:
 # -- per-process default recorder -------------------------------------
 
 _recorder: Optional[FlightRecorder] = None
+# listeners on EVERY recorded event (any recorder instance):
+# ``fn(entry_dict)``.  The goodput ledger attributes watchdog-reported
+# hang seconds through this hook — no new instrumentation at the
+# emission sites.
+_event_sinks: List = []
 _install_lock = threading.Lock()
+
+
+def add_event_sink(fn) -> None:
+    """Register an event listener (idempotent per function object)."""
+    with _install_lock:
+        if fn not in _event_sinks:
+            _event_sinks.append(fn)
+
+
+def remove_event_sink(fn) -> None:
+    with _install_lock:
+        try:
+            _event_sinks.remove(fn)
+        except ValueError:
+            pass
 
 
 def install(recorder: Optional[FlightRecorder]) -> Optional[FlightRecorder]:

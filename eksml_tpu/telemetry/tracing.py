@@ -148,6 +148,15 @@ class Tracer:
         with self._lock:
             self._ring.append(ev)
             self.spans_recorded += 1
+        # span sink (goodput ledger): notified OUTSIDE the ring lock —
+        # a sink must never extend this hot-path critical section, and
+        # it must never take down the traced code
+        sink = _span_sink
+        if sink is not None:
+            try:
+                sink(str(name), t1 - t0, step)
+            except Exception:  # noqa: BLE001 — observability only
+                log.exception("span sink failed for %r", name)
 
     def instant(self, name: str, step: Optional[int] = None,
                 **attrs) -> None:
@@ -202,7 +211,23 @@ class Tracer:
 # -- module-level installed tracer (same pattern as the recorder) ------
 
 _tracer: Optional[Tracer] = None
+# optional listener on completed spans: ``fn(name, dur_s, step)``.
+# The goodput ledger classifies run wall-clock through this hook
+# instead of adding its own hot-path instrumentation.  With no tracer
+# installed (tracing disabled) no spans complete and the sink never
+# fires — the ledger's documented coarse mode.
+_span_sink = None
 _install_lock = threading.Lock()
+
+
+def install_span_sink(fn) -> Optional[object]:
+    """Install (or with ``None``, remove) the span sink; returns the
+    previous one so callers can restore it (fit installs the goodput
+    meter's for the duration of the loop)."""
+    global _span_sink
+    with _install_lock:
+        prev, _span_sink = _span_sink, fn
+    return prev
 
 
 def install_tracer(tracer: Optional[Tracer]) -> Optional[Tracer]:

@@ -166,6 +166,14 @@ def _tracing_knobs(cfg) -> Dict[str, Any]:
         TELEMETRY_TRACING_DEFAULTS)
 
 
+def _goodput_knobs(cfg) -> Dict[str, Any]:
+    from eksml_tpu.config import TELEMETRY_GOODPUT_DEFAULTS
+
+    return _knobs_with_fallback(
+        getattr(getattr(cfg, "TELEMETRY", None), "GOODPUT", None),
+        TELEMETRY_GOODPUT_DEFAULTS)
+
+
 def cast_params_for_storage(params, param_dtype: str):
     """TRAIN.PARAM_DTYPE storage cast (the 1344/b8 memory plan): f32
     leaves → bf16; everything else keeps its dtype.  ONE definition
@@ -251,6 +259,28 @@ def _preregister_core_metrics(registry) -> None:
             "eksml_data_quarantined_records",
             "distinct records quarantined by the data-ingest layer",
             labels={"kind": kind})
+    # goodput ledger (telemetry/goodput.py): the badput family is
+    # labeled by bucket everywhere it increments — preregister every
+    # bucket (and the ratio gauge) so the FIRST scrape of a healthy
+    # run shows the whole taxonomy at 0, and the phase events the
+    # ledger reads (eval/compile, this PR's flight-recorder additions)
+    # exist as countable series before the first incident
+    from eksml_tpu.telemetry import goodput as goodput_mod
+
+    registry.gauge(goodput_mod.RATIO_GAUGE,
+                   "fraction of run wall-clock spent in train steps")
+    registry.counter(goodput_mod.GOODPUT_COUNTER,
+                     "training wall-clock seconds (the goodput "
+                     "bucket)")
+    for bucket in goodput_mod.BADPUT_BUCKETS:
+        registry.counter(goodput_mod.BADPUT_COUNTER,
+                         "non-training wall-clock seconds by bucket",
+                         labels={"bucket": bucket})
+    for kind in ("compile_start", "compile_done", "eval_start",
+                 "eval_done"):
+        registry.counter("eksml_flight_events",
+                         "flight-recorder events by kind",
+                         labels={"kind": kind})
 
 
 def _config_digest(cfg) -> str:
@@ -317,6 +347,10 @@ class Trainer:
         # (or its flight-recorder event files)
         self._telemetry = _telemetry_knobs(cfg)
         self._tracing = _tracing_knobs(cfg)
+        self._goodput_cfg = _goodput_knobs(cfg)
+        # live goodput meter — non-None only while fit runs (set up
+        # there; _run_eval/_rollback credit through it)
+        self._goodput = None
         run_info = {"config_digest": _config_digest(cfg)}
         self.writer = (MetricWriter(logdir, run_info=run_info)
                        if write_metrics and jax.process_index() == 0
@@ -576,6 +610,16 @@ class Trainer:
         boundaries, and with tracing enabled the span ring flushes to
         ``<logdir>/trace-host<i>.json`` alongside the profiler trace.
 
+        Goodput ledger (telemetry/goodput.py, ``TELEMETRY.GOODPUT.*``
+        knobs): the run's wall-clock is classified into goodput vs
+        badput buckets from the span/event exhaust above, downtime
+        since the previous relaunch is recovered at fit start, the
+        rolling ``eksml_goodput_ratio`` +
+        ``eksml_badput_seconds_total{bucket=}`` land on /metrics at
+        each log interval, and per-segment snapshots bank to
+        ``<logdir>/goodput-host<i>.jsonl`` for the cross-restart
+        merge (tools/goodput_report.py).
+
         Resilience wiring (eksml_tpu/resilience/, knobs under
         ``config.RESILIENCE``): SIGTERM forces a checkpoint at the next
         step boundary and exits with the resumable code; non-finite
@@ -641,6 +685,12 @@ class Trainer:
         _preregister_core_metrics(registry)
         if data_health is not None:
             data_health.register_gauges(registry)
+        # goodput ledger state — set up INSIDE the try below so any
+        # later setup failure still reaches the finally that removes
+        # the sinks (a leaked sink would feed every later fit's spans
+        # into a dead meter — the PR 5 leaked-tracer class)
+        goodput_bank_path = None
+        prev_span_sink = None
         health_state = {"step": start_step, "total_steps": total_steps}
         # monotonic PROGRESS clock for /healthz liveness: the probe
         # reads seconds_since_last_step and (past the
@@ -727,6 +777,34 @@ class Trainer:
             # so a finished run's tracer can't swallow later spans
             telemetry.install_tracer(self.tracer)
         try:
+            # goodput ledger (telemetry/goodput.py): classify this
+            # fit's wall-clock from the EXISTING span/event exhaust.
+            # Downtime since the previous segment is recovered NOW
+            # from the shared event file + checkpoint timestamps, so
+            # the live eksml_goodput_ratio already reflects the
+            # restart gap the relaunch is paying for.  self._goodput
+            # is assigned BEFORE the sinks install, so the finally's
+            # cleanup runs even for a partial setup.
+            if (self._telemetry["ENABLED"]
+                    and self._goodput_cfg["ENABLED"]):
+                from eksml_tpu.telemetry import goodput as goodput_mod
+
+                down_s, seg_start = goodput_mod.recover_downtime(
+                    self.logdir, jax.process_index())
+                meter = telemetry.GoodputMeter(
+                    fine=self.tracer is not None,
+                    segment_start_wall=seg_start)
+                if down_s > 0:
+                    meter.credit("downtime", down_s)
+                    log.info("goodput: recovered %.1fs downtime since "
+                             "the previous segment", down_s)
+                self._goodput = meter
+                prev_span_sink = telemetry.install_span_sink(
+                    meter.on_span)
+                telemetry.add_event_sink(meter.on_event)
+                if self._goodput_cfg["BANK"]:
+                    goodput_bank_path = telemetry.goodput_path_for(
+                        self.logdir, jax.process_index())
             # exporter starts INSIDE the try so any setup failure
             # below still reaches the finally that stops it — a leaked
             # server would squat the fixed port and keep serving stale
@@ -780,8 +858,18 @@ class Trainer:
                     device_batch = (batch if prefetcher is not None
                                     else self._globalize_batch(batch))
                 if state is None:
+                    t_restore = time.perf_counter()
                     state, step = self.restore_or_init(device_batch)
                     _progress()  # a multi-GB restore is not a hang
+                    if self._goodput is not None and step > 0:
+                        # an actual resume: the whole restore walk is
+                        # checkpoint_restore wall.  coarse_only — with
+                        # spans on, the checkpoint_restore span inside
+                        # the manager already fed the sink.
+                        self._goodput.credit(
+                            "checkpoint_restore",
+                            time.perf_counter() - t_restore,
+                            coarse_only=True)
                     if step >= total_steps:
                         break
                 first_call = step_fn is None
@@ -792,6 +880,15 @@ class Trainer:
                     # globalize_batch (the previous beat)
                     watchdog.beat("train_step", step + 1)
                 if first_call:
+                    # first-shape compile window: the flight recorder
+                    # gets explicit boundaries (the event stream was
+                    # blind to compile — it read as a silent gap) and
+                    # the goodput meter routes the first train_step
+                    # span into the compile bucket instead of goodput
+                    telemetry.event("compile_start", step=step + 1)
+                    t_compile = time.perf_counter()
+                    if self._goodput is not None:
+                        self._goodput.begin_compile()
                     step_fn = self._step_fn_with_prediction(
                         self.compiled_step(), state, device_batch)
                 # host-side dispatch of the compiled step (the device
@@ -803,6 +900,13 @@ class Trainer:
                     # the compile happened inside that call; from here
                     # the steady-state deadline applies
                     watchdog.end_compile_headroom()
+                if first_call:
+                    compile_s = time.perf_counter() - t_compile
+                    telemetry.event(
+                        "compile_done", step=step + 1,
+                        compile_ms=round(compile_s * 1e3, 1))
+                    if self._goodput is not None:
+                        self._goodput.end_compile(compile_s)
                 step += 1
                 steps_since_log += 1
                 health_state["step"] = step
@@ -874,9 +978,17 @@ class Trainer:
                     action = sentinel.observe(
                         step, float(np.asarray(metrics["total_loss"])))  # eksml-lint: disable=host-sync
                     if action == ROLLBACK:
+                        t_rb = time.perf_counter()
                         state, step = self._rollback(sentinel, state,
                                                      step,
                                                      watchdog=watchdog)
+                        if self._goodput is not None:
+                            # mid-run divergence recovery is restore
+                            # wall too (span covers it in fine mode)
+                            self._goodput.credit(
+                                "checkpoint_restore",
+                                time.perf_counter() - t_rb,
+                                coarse_only=True)
                         _progress()  # recovery, not a hang
                         steps_since_log = 0
                         t_last = time.time()
@@ -968,6 +1080,19 @@ class Trainer:
                                 reason=reason,
                                 capture=("accepted" if ok
                                          else detail))
+                    if self._goodput is not None:
+                        # rolling run-level SLI: the ratio gauge +
+                        # monotonic per-bucket badput counters land on
+                        # /metrics (the elastic controller's inputs),
+                        # the banked snapshot line is what makes the
+                        # ledger survive this process
+                        snap = self._goodput.publish(registry,
+                                                     steps=step)
+                        metrics["goodput/ratio"] = \
+                            snap["goodput_ratio"]
+                        if goodput_bank_path:
+                            self._goodput.bank(goodput_bank_path,
+                                               steps=step)
                     if self.writer:
                         self.writer.write_scalars(step, metrics)
                     log.info("step %d/%d loss=%.4f (%.1f img/s)", step,
@@ -1011,6 +1136,13 @@ class Trainer:
                         if self.writer:
                             self.writer.write_scalars(step, {
                                 "checkpoint_save_ms": save_ms})
+                        if self._goodput is not None:
+                            # the step-loop blocking portion only —
+                            # the async persist overlaps training by
+                            # design and is not badput
+                            self._goodput.credit(
+                                "checkpoint_save", save_ms / 1e3,
+                                coarse_only=True)
                         _progress()  # a slow shared-fs commit is not a hang
                 if self.eval_fn and (step % eval_every == 0
                                      or step == total_steps):
@@ -1039,6 +1171,21 @@ class Trainer:
                 # won't raise)
                 self._finish_capture(capture, profile_trigger, step,
                                      truncated=True)
+            if self._goodput is not None:
+                # final snapshot: the exporter may already be gone but
+                # the banked line is the segment's authoritative
+                # ledger row for the cross-restart merge — land it on
+                # EVERY exit path (preemption included)
+                try:
+                    self._goodput.publish(registry, steps=step)
+                    if goodput_bank_path:
+                        self._goodput.bank(goodput_bank_path,
+                                           steps=step, final=True)
+                except Exception:  # noqa: BLE001 — observability only
+                    log.exception("final goodput snapshot failed")
+                telemetry.remove_event_sink(self._goodput.on_event)
+                telemetry.install_span_sink(prev_span_sink)
+                self._goodput = None
             if self.tracer is not None:
                 # steady-state spans land even without a capture: the
                 # cross-host merge works from whatever the ring holds
@@ -1288,6 +1435,13 @@ class Trainer:
         raise preempt.preempted(step)
 
     def _run_eval(self, state, step):
+        # explicit eval boundaries in the event stream: eval was
+        # invisible to the flight recorder (a long silent gap), so the
+        # goodput ledger would misattribute it to host_overhead.  The
+        # done event carries the measured wall either way it ends.
+        telemetry.event("eval_start", step=step)
+        t_eval = time.perf_counter()
+        ok = True
         try:
             params = state.params
             if self.plan.strategy != "replicated":
@@ -1301,7 +1455,16 @@ class Trainer:
                 self.writer.write_scalars(
                     step, {f"val/{k}": v for k, v in results.items()})
         except Exception:
+            ok = False
             log.exception("eval at step %d failed", step)
+        finally:
+            eval_s = time.perf_counter() - t_eval
+            telemetry.event("eval_done", step=step, ok=ok,
+                            eval_ms=round(eval_s * 1e3, 1))
+            if self._goodput is not None:
+                # coarse_only: in fine mode the eval span above
+                # already fed the sink
+                self._goodput.credit("eval", eval_s, coarse_only=True)
 
 
 # ---- CLI ------------------------------------------------------------

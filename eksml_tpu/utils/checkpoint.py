@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -82,6 +83,7 @@ class CheckpointManager:
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         # span = the step-loop BLOCKING portion (async snapshot +
         # dispatch); the background persist is invisible here by design
+        t_save = time.perf_counter()
         with telemetry.span("checkpoint_save", step=step):
             saved = self._mngr.save(
                 step, args=ocp.args.StandardSave(state), force=force)
@@ -94,8 +96,10 @@ class CheckpointManager:
             telemetry.default_registry().counter(
                 "eksml_checkpoint_saves",
                 "checkpoint commits started").inc()
-            telemetry.event("checkpoint_save", step=step,
-                            forced=bool(force))
+            telemetry.event(
+                "checkpoint_save", step=step, forced=bool(force),
+                save_ms=round((time.perf_counter() - t_save) * 1e3,
+                              1))
         return saved
 
     def _write_pending_manifests(self, exclude: Optional[int] = None) -> None:
@@ -185,6 +189,10 @@ class CheckpointManager:
         # falling back to the structural check
         self._mngr.wait_until_finished()
         self._write_pending_manifests()
+        # restore_ms on the success event = the WHOLE walk (verify +
+        # failed layouts + the restore that stuck) — the wall-clock
+        # the goodput ledger's checkpoint_restore bucket accounts for
+        t_restore = time.perf_counter()
         tried = set()
         while True:
             step = self._agreed_candidate()
@@ -248,8 +256,12 @@ class CheckpointManager:
                     telemetry.default_registry().counter(
                         "eksml_checkpoint_restores",
                         "checkpoint restores completed").inc()
-                    telemetry.event("checkpoint_restore", step=step,
-                                    resharded=True)
+                    telemetry.event(
+                        "checkpoint_restore", step=step,
+                        resharded=True,
+                        restore_ms=round(
+                            (time.perf_counter() - t_restore) * 1e3,
+                            1))
                     if mismatch:
                         self._note_resharded(step, saved_topo)
                     return out, step
@@ -264,7 +276,10 @@ class CheckpointManager:
                 telemetry.default_registry().counter(
                     "eksml_checkpoint_restores",
                     "checkpoint restores completed").inc()
-                telemetry.event("checkpoint_restore", step=step)
+                telemetry.event(
+                    "checkpoint_restore", step=step,
+                    restore_ms=round(
+                        (time.perf_counter() - t_restore) * 1e3, 1))
                 if mismatch:
                     self._note_resharded(step, saved_topo)
                 return out, step

@@ -1,0 +1,571 @@
+"""Unit half of the goodput ledger (ISSUE 13).
+
+GoodputMeter bucket math (span routing, compile window, residual
+modes, monotonic exporter counters), restart-gap recovery from the
+event stream + checkpoint timestamps, and the offline cross-restart
+ledger (tools/goodput_report.py renders it; the subprocess half —
+SIGTERM, relaunch, nonzero downtime asserted against a live trainer —
+is the ``proc-goodput-preempt`` chaos rung in
+tests/test_fault_tolerance.py).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eksml_tpu import telemetry
+from eksml_tpu.telemetry import goodput
+from eksml_tpu.telemetry.goodput import (BADPUT_BUCKETS, BUCKETS,
+                                         GoodputMeter, build_ledger,
+                                         recover_downtime)
+from eksml_tpu.telemetry.registry import MetricRegistry
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---- meter: bucket math ---------------------------------------------
+
+
+def test_coarse_mode_residual_reads_as_train():
+    """Without spans the meter cannot split steps from stalls — the
+    unattributed residual lands in train_step (goodput becomes an
+    upper bound, the documented coarse blind spot)."""
+    clock = FakeClock(1000.0)
+    m = GoodputMeter(fine=False, clock=clock)
+    clock.t = 1010.0
+    m.credit("checkpoint_save", 2.0)
+    snap = m.snapshot()
+    assert snap["mode"] == "coarse"
+    assert snap["buckets"]["train_step"] == pytest.approx(8.0)
+    assert snap["buckets"]["checkpoint_save"] == pytest.approx(2.0)
+    assert snap["goodput_ratio"] == pytest.approx(0.8)
+
+
+def test_fine_mode_residual_reads_as_host_overhead():
+    clock = FakeClock(0.0)
+    m = GoodputMeter(fine=True, clock=clock)
+    m.on_span("train_step", 6.0)
+    m.on_span("data_wait", 2.0)
+    clock.t = 10.0
+    snap = m.snapshot()
+    assert snap["mode"] == "spans"
+    assert snap["buckets"]["train_step"] == pytest.approx(6.0)
+    assert snap["buckets"]["data_wait"] == pytest.approx(2.0)
+    assert snap["buckets"]["host_overhead"] == pytest.approx(2.0)
+    assert snap["goodput_ratio"] == pytest.approx(0.6)
+
+
+def test_compile_window_routes_first_step_span():
+    """The first step-fn call IS the compile: its train_step span
+    must land in the compile bucket, not in goodput."""
+    clock = FakeClock(0.0)
+    m = GoodputMeter(fine=True, clock=clock)
+    m.begin_compile()
+    m.on_span("train_step", 30.0)  # the compiling first call
+    m.end_compile(30.0)            # fine mode: no double credit
+    m.on_span("train_step", 1.0)   # steady state
+    clock.t = 31.0
+    snap = m.snapshot()
+    assert snap["buckets"]["compile"] == pytest.approx(30.0)
+    assert snap["buckets"]["train_step"] == pytest.approx(1.0)
+
+
+def test_fine_compile_covers_aot_lowering_outside_spans():
+    """The PREDICTED_STEP_TIME path AOT-compiles BEFORE the first
+    dispatch span — the measured window must book the uncovered share
+    so a multi-minute lowering cannot hide in host_overhead (caught
+    by the verify drive: compile read 0.02s of a 15s lowering)."""
+    clock = FakeClock(0.0)
+    m = GoodputMeter(fine=True, clock=clock)
+    m.begin_compile()
+    m.on_span("train_step", 0.5)   # the (fast) AOT-executable dispatch
+    m.end_compile(15.0)            # the whole window incl. lowering
+    clock.t = 15.0
+    snap = m.snapshot()
+    assert snap["buckets"]["compile"] == pytest.approx(15.0)
+    assert snap["buckets"]["train_step"] == 0.0
+    assert snap["buckets"]["host_overhead"] == pytest.approx(0.0)
+
+
+def test_coarse_compile_uses_measured_wall():
+    clock = FakeClock(0.0)
+    m = GoodputMeter(fine=False, clock=clock)
+    m.begin_compile()
+    m.end_compile(25.0)
+    clock.t = 30.0
+    snap = m.snapshot()
+    assert snap["buckets"]["compile"] == pytest.approx(25.0)
+    assert snap["buckets"]["train_step"] == pytest.approx(5.0)
+
+
+def test_producer_thread_spans_are_ignored():
+    """h2d_prefetch/batch_build run on worker threads OVERLAPPING the
+    loop — crediting them would double-count wall-clock."""
+    m = GoodputMeter(fine=True, clock=FakeClock())
+    m.on_span("h2d_prefetch", 5.0)
+    m.on_span("batch_build", 5.0)
+    assert sum(m.snapshot()["buckets"].values()) == 0.0
+
+
+def test_coarse_only_credits_skip_fine_mode():
+    """Phases a span already covers (checkpoint/eval/restore) must
+    not be credited twice when the span sink is live."""
+    fine = GoodputMeter(fine=True, clock=FakeClock())
+    fine.credit("eval", 4.0, coarse_only=True)
+    assert fine.snapshot()["buckets"]["eval"] == 0.0
+    coarse = GoodputMeter(fine=False, clock=FakeClock())
+    coarse.credit("eval", 4.0, coarse_only=True)
+    assert coarse.snapshot()["buckets"]["eval"] == pytest.approx(4.0)
+
+
+def test_event_sink_attributes_watchdog_hang():
+    m = GoodputMeter(fine=True, clock=FakeClock())
+    m.on_event({"kind": "watchdog_dump", "stalled_sec": 12.5})
+    m.on_event({"kind": "checkpoint_save", "step": 3})  # no duration
+    m.on_event({"kind": "watchdog_dump", "stalled_sec": "garbage"})
+    assert m.snapshot()["buckets"]["hang"] == pytest.approx(12.5)
+
+
+def test_recovered_downtime_rides_wall_and_ratio():
+    clock = FakeClock(100.0)
+    m = GoodputMeter(fine=True, clock=clock)
+    m.credit("downtime", 10.0)
+    m.on_span("train_step", 5.0)
+    clock.t = 105.0
+    snap = m.snapshot()
+    assert snap["elapsed_s"] == pytest.approx(5.0)
+    assert snap["wall_s"] == pytest.approx(15.0)
+    assert snap["goodput_ratio"] == pytest.approx(5.0 / 15.0)
+
+
+# ---- meter: exporter publication ------------------------------------
+
+
+def test_publish_series_names_and_monotonic_counters():
+    clock = FakeClock(0.0)
+    m = GoodputMeter(fine=True, clock=clock)
+    reg = MetricRegistry()
+    m.on_span("data_wait", 3.0)
+    m.on_span("train_step", 6.0)
+    clock.t = 10.0
+    m.publish(reg, steps=4)
+    from test_telemetry import parse_openmetrics
+
+    from eksml_tpu.telemetry.exporter import render_openmetrics
+
+    fams = parse_openmetrics(render_openmetrics(reg))
+    assert fams["eksml_goodput_ratio"]["samples"][
+        "eksml_goodput_ratio"] == pytest.approx(0.6)
+    assert fams["eksml_badput_seconds"]["samples"][
+        'eksml_badput_seconds_total{bucket="data_wait"}'] == \
+        pytest.approx(3.0)
+    assert fams["eksml_goodput_seconds"]["samples"][
+        "eksml_goodput_seconds_total"] == pytest.approx(6.0)
+    # counters stay monotonic across publishes even when the residual
+    # reclassifies (clamped deltas, remembered high-water marks)
+    clock.t = 12.0
+    m.on_span("train_step", 2.0)
+    m.publish(reg, steps=5)
+    fams2 = parse_openmetrics(render_openmetrics(reg))
+    for fam in ("eksml_badput_seconds", "eksml_goodput_seconds"):
+        for key, v in fams2[fam]["samples"].items():
+            assert v >= fams[fam]["samples"].get(key, 0.0), (key, v)
+
+
+def test_span_sink_fires_through_installed_tracer():
+    """The meter is fed by the EXISTING span layer: installing the
+    sink next to a live tracer classifies module-level spans with no
+    new instrumentation; removing it stops the feed."""
+    m = GoodputMeter(fine=True, clock=FakeClock())
+    tracer = telemetry.Tracer(capacity=64)
+    prev_t = telemetry.install_tracer(tracer)
+    prev_s = telemetry.install_span_sink(m.on_span)
+    try:
+        import time as time_mod
+
+        with telemetry.span("data_wait", step=1):
+            time_mod.sleep(0.005)
+        with telemetry.span("train_step", step=1):
+            time_mod.sleep(0.005)
+        with telemetry.span("unmapped_name", step=1):
+            time_mod.sleep(0.005)
+    finally:
+        telemetry.install_span_sink(prev_s)
+        telemetry.install_tracer(prev_t)
+    buckets = m.snapshot()["buckets"]
+    assert buckets["data_wait"] > 0.0
+    with telemetry.span("data_wait", step=2):
+        pass  # sink removed: no further credit
+    assert m.snapshot()["buckets"]["data_wait"] == \
+        buckets["data_wait"]
+
+
+def test_event_sink_registration_round_trip():
+    m = GoodputMeter(fine=False, clock=FakeClock())
+    rec = telemetry.FlightRecorder(capacity=16)
+    prev = telemetry.install(rec)
+    telemetry.add_event_sink(m.on_event)
+    try:
+        telemetry.event("watchdog_dump", step=1, phase="train_step",
+                        stalled_sec=7.0)
+    finally:
+        telemetry.remove_event_sink(m.on_event)
+        telemetry.install(prev)
+        rec.close()
+    assert m.snapshot()["buckets"]["hang"] == pytest.approx(7.0)
+    telemetry.event("noop")  # removed sink must not fire
+
+
+def test_bank_appends_parseable_lines(tmp_path):
+    clock = FakeClock(50.0)
+    m = GoodputMeter(fine=False, clock=clock)
+    path = str(tmp_path / "goodput-host0.jsonl")
+    clock.t = 60.0
+    m.bank(path, steps=3)
+    clock.t = 70.0
+    m.bank(path, steps=6, final=True)
+    rows = [json.loads(line) for line in open(path)]
+    assert len(rows) == 2
+    assert rows[0]["steps"] == 3 and "final" not in rows[0]
+    assert rows[1]["final"] is True
+    assert set(rows[1]["buckets"]) == set(BUCKETS)
+    # unwritable path: counted, never raised
+    m.bank(str(tmp_path / "no-such-dir" / "x.jsonl"))
+    assert m.bank_failures == 1
+
+
+# ---- restart-gap recovery -------------------------------------------
+
+
+def _write_events(logdir, events, host=0):
+    os.makedirs(logdir, exist_ok=True)
+    with open(os.path.join(logdir, f"events-host{host}.jsonl"),
+              "a") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_recover_downtime_from_event_gap(tmp_path):
+    logdir = str(tmp_path)
+    _write_events(logdir, [
+        {"time": 100.0, "kind": "run_start", "host": 0},
+        {"time": 140.0, "kind": "preempt_exit", "step": 8},
+        {"time": 200.0, "kind": "run_start", "host": 0},
+    ])
+    down, seg_start = recover_downtime(logdir, 0)
+    assert down == pytest.approx(60.0)
+    assert seg_start == pytest.approx(200.0)
+
+
+def test_recover_downtime_uses_checkpoint_mtime(tmp_path):
+    """A SIGKILLed segment flushes no exit event — its newest
+    checkpoint commit is the last provable activity, so the gap is
+    measured from the checkpoint mtime, not the stale last event."""
+    logdir = str(tmp_path)
+    _write_events(logdir, [
+        {"time": 100.0, "kind": "run_start", "host": 0},
+        {"time": 110.0, "kind": "checkpoint_save", "step": 2},
+        {"time": 300.0, "kind": "run_start", "host": 0},
+    ])
+    step_dir = tmp_path / "checkpoints" / "4"
+    step_dir.mkdir(parents=True)
+    os.utime(step_dir, (250.0, 250.0))
+    down, _ = recover_downtime(logdir, 0)
+    assert down == pytest.approx(50.0)
+
+
+def test_recover_downtime_first_launch_is_zero(tmp_path):
+    assert recover_downtime(str(tmp_path), 0) == (0.0, None)
+    _write_events(str(tmp_path), [
+        {"time": 100.0, "kind": "run_start", "host": 0}])
+    down, seg_start = recover_downtime(str(tmp_path), 0)
+    assert down == 0.0 and seg_start == pytest.approx(100.0)
+
+
+# ---- offline cross-restart ledger -----------------------------------
+
+
+def _two_segment_logdir(tmp_path, second_extra=()):
+    logdir = str(tmp_path)
+    _write_events(logdir, [
+        {"time": 1000.0, "kind": "run_start", "host": 0,
+         "host_count": 2, "config_digest": "aaa"},
+        {"time": 1008.0, "kind": "compile_done", "step": 1,
+         "compile_ms": 8000.0},
+        {"time": 1030.0, "kind": "checkpoint_save", "step": 4,
+         "forced": True, "save_ms": 1500.0},
+        {"time": 1031.0, "kind": "preempt_exit", "step": 4},
+        {"time": 1050.0, "kind": "run_start", "host": 0,
+         "host_count": 1, "config_digest": "aaa"},
+        {"time": 1053.0, "kind": "checkpoint_restore", "step": 4,
+         "restore_ms": 2500.0, **dict(second_extra)},
+        {"time": 1080.0, "kind": "checkpoint_save", "step": 8,
+         "save_ms": 1000.0},
+    ])
+    return logdir
+
+
+def test_ledger_bucket_classification_from_events_and_spans(tmp_path):
+    """Hand-written events + a span trace: spans supersede the event
+    durations for the phases both cover, event-only phases (compile,
+    hang) keep their measured fields, train/data come from spans."""
+    logdir = _two_segment_logdir(tmp_path)
+    spans = [
+        # segment 1 (wall-epoch µs timestamps, the tracer contract)
+        {"ph": "X", "name": "train_step", "ts": 1010.0e6,
+         "dur": 4.0e6, "pid": 0, "args": {"step": 2}},
+        {"ph": "X", "name": "data_wait", "ts": 1015.0e6,
+         "dur": 3.0e6, "pid": 0, "args": {"step": 3}},
+        {"ph": "X", "name": "globalize_batch", "ts": 1019.0e6,
+         "dur": 1.0e6, "pid": 0, "args": {"step": 3}},
+        {"ph": "X", "name": "checkpoint_save", "ts": 1029.0e6,
+         "dur": 1.2e6, "pid": 0, "args": {"step": 4}},
+        {"ph": "X", "name": "h2d_prefetch", "ts": 1020.0e6,
+         "dur": 9.0e6, "pid": 0, "args": {}},  # overlapped: ignored
+        # segment 2
+        {"ph": "X", "name": "train_step", "ts": 1060.0e6,
+         "dur": 6.0e6, "pid": 0, "args": {"step": 6}},
+    ]
+    with open(os.path.join(logdir, "trace-host0.json"), "w") as f:
+        json.dump({"traceEvents": spans}, f)
+    led = build_ledger(logdir)
+    assert len(led["segments"]) == 2
+    s1, s2 = led["segments"]
+    assert s1["mode"] == "events+spans"
+    assert s1["buckets"]["train_step"] == pytest.approx(4.0)
+    assert s1["buckets"]["data_wait"] == pytest.approx(3.0)
+    assert s1["buckets"]["h2d_prefetch_wait"] == pytest.approx(1.0)
+    assert s1["buckets"]["compile"] == pytest.approx(8.0)
+    # span measurement supersedes the event's save_ms
+    assert s1["buckets"]["checkpoint_save"] == pytest.approx(1.2)
+    assert s2["buckets"]["train_step"] == pytest.approx(6.0)
+    assert led["downtime"]["between_segments_s"] == [
+        pytest.approx(19.0)]
+    assert led["buckets"]["downtime"] == pytest.approx(19.0)
+    assert led["goodput_ratio"] == pytest.approx(
+        10.0 / led["total_wall_s"])
+    assert led["segments"][0]["host_count"] == 2
+
+
+def test_ledger_cross_restart_downtime_two_run_starts(tmp_path):
+    led = build_ledger(_two_segment_logdir(tmp_path))
+    assert len(led["segments"]) == 2
+    assert led["downtime"]["total_s"] == pytest.approx(19.0)
+    assert led["buckets"]["checkpoint_restore"] == pytest.approx(2.5)
+    assert led["total_wall_s"] == pytest.approx(80.0)
+    # consistency: the published ratio IS train/total
+    assert led["goodput_ratio"] == pytest.approx(
+        led["train_s"] / led["total_wall_s"])
+
+
+def test_ledger_elastic_reshard_segment_boundary(tmp_path):
+    """A grow/shrink relaunch: the resharded restore marks ITS
+    segment, segmentation and downtime recovery are unchanged."""
+    led = build_ledger(_two_segment_logdir(
+        tmp_path, second_extra=(("resharded", True),)))
+    assert led["segments"][0]["resharded"] is False
+    assert led["segments"][1]["resharded"] is True
+    assert led["downtime"]["total_s"] == pytest.approx(19.0)
+
+
+def test_ledger_degrades_without_spans_or_bank(tmp_path):
+    """TRACING.ENABLED=False leaves only events: coarser buckets
+    (train from the metric stream), never a crash."""
+    logdir = _two_segment_logdir(tmp_path)
+    rows = [{"step": s, "time": 1010.0 + 2 * s,
+             "step_time_ms": 1000.0, "total_loss": 1.0}
+            for s in range(1, 5)]
+    with open(os.path.join(logdir, "metrics.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    led = build_ledger(logdir)
+    assert led["segments"][0]["mode"] == "events"
+    assert led["segments"][0]["buckets"]["train_step"] == \
+        pytest.approx(4.0)  # 4 rows x 1s x 1 step each
+    assert led["segments"][0]["buckets"]["compile"] == \
+        pytest.approx(8.0)
+
+
+def test_ledger_empty_logdir_degrades_to_note(tmp_path):
+    led = build_ledger(str(tmp_path))
+    assert led["segments"] == []
+    assert "note" in led
+    assert led["goodput_ratio"] == 0.0
+
+
+def test_ledger_prefers_banked_snapshots_and_drops_their_downtime(
+        tmp_path):
+    """The live meter's banked snapshot is the segment's exact
+    accounting — but its recovered-downtime bucket describes the SAME
+    boundary the ledger derives from timestamps; keeping both would
+    double-count the gap."""
+    logdir = _two_segment_logdir(tmp_path)
+    snap = {
+        "time": 1081.0, "segment_start": 1050.0, "elapsed_s": 31.0,
+        "wall_s": 50.0, "mode": "spans", "steps": 8,
+        "buckets": {b: 0.0 for b in BUCKETS},
+        "goodput_ratio": 0.5,
+    }
+    snap["buckets"].update({"train_step": 20.0, "downtime": 19.0,
+                            "checkpoint_restore": 2.0})
+    with open(os.path.join(logdir, "goodput-host0.jsonl"), "w") as f:
+        f.write(json.dumps(snap) + "\n")
+    led = build_ledger(logdir)
+    s2 = led["segments"][1]
+    assert s2["mode"] == "banked:spans"
+    assert s2["steps"] == 8
+    assert s2["buckets"]["train_step"] == pytest.approx(20.0)
+    assert s2["buckets"]["downtime"] == 0.0
+    assert led["buckets"]["downtime"] == pytest.approx(19.0)
+
+
+def test_ledger_offline_compile_window_not_double_counted(tmp_path):
+    """The first train_step span IS the compiling dispatch; offline
+    reconstruction must not book its wall under BOTH compile
+    (compile_ms) and train_step — the live meter's _in_compile
+    routing, reproduced from the event-recorded compile window."""
+    logdir = str(tmp_path)
+    _write_events(logdir, [
+        {"time": 1000.0, "kind": "run_start", "host": 0},
+        {"time": 1001.0, "kind": "compile_start", "step": 1},
+        {"time": 1009.0, "kind": "compile_done", "step": 1,
+         "compile_ms": 8000.0},
+        {"time": 1020.0, "kind": "checkpoint_save", "step": 4,
+         "save_ms": 100.0},
+    ])
+    spans = [
+        {"ph": "X", "name": "train_step", "ts": 1002.0e6,
+         "dur": 7.0e6, "pid": 0, "args": {"step": 1}},  # in-window
+        {"ph": "X", "name": "train_step", "ts": 1012.0e6,
+         "dur": 2.0e6, "pid": 0, "args": {"step": 2}},  # steady state
+    ]
+    with open(os.path.join(logdir, "trace-host0.json"), "w") as f:
+        json.dump({"traceEvents": spans}, f)
+    seg = build_ledger(logdir)["segments"][0]
+    assert seg["buckets"]["compile"] == pytest.approx(8.0)
+    assert seg["buckets"]["train_step"] == pytest.approx(2.0)
+
+
+def test_ledger_crash_loop_does_not_steal_banked_snapshots(tmp_path):
+    """Relaunches closer together than the match tolerance: a banked
+    snapshot belongs to the NEAREST run_start, so a segment that died
+    before banking cannot inherit (and double-count) the previous
+    segment's cumulative row."""
+    logdir = str(tmp_path)
+    _write_events(logdir, [
+        {"time": 1000.0, "kind": "run_start", "host": 0},
+        {"time": 1000.9, "kind": "run_start", "host": 0},  # crash loop
+        {"time": 1010.0, "kind": "checkpoint_save", "step": 2,
+         "save_ms": 100.0},
+    ])
+    snap = {"time": 1000.5, "segment_start": 1000.0,
+            "elapsed_s": 0.5, "wall_s": 0.5, "mode": "coarse",
+            "steps": 1, "goodput_ratio": 1.0,
+            "buckets": {b: 0.0 for b in BUCKETS}}
+    snap["buckets"]["train_step"] = 0.5
+    with open(os.path.join(logdir, "goodput-host0.jsonl"), "w") as f:
+        f.write(json.dumps(snap) + "\n")
+    led = build_ledger(logdir)
+    s1, s2 = led["segments"]
+    assert s1["mode"].startswith("banked")
+    assert s1["buckets"]["train_step"] == pytest.approx(0.5)
+    assert not s2["mode"].startswith("banked"), s2
+    assert led["train_s"] == pytest.approx(0.5)
+
+
+# ---- report tooling --------------------------------------------------
+
+
+def test_goodput_report_cli_writes_ledger(tmp_path, capsys):
+    from tools import goodput_report
+
+    logdir = _two_segment_logdir(tmp_path / "run")
+    out = str(tmp_path / "ledger.json")
+    rc = goodput_report.main([logdir, "--out", out,
+                              "--artifacts", str(tmp_path / "none")])
+    assert rc == 0
+    banked = json.loads(open(out).read())
+    assert banked["downtime"]["total_s"] == pytest.approx(19.0)
+    assert "note" in banked["effective_mfu"]  # no perf_pred artifacts
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["goodput_ratio"] == banked["goodput_ratio"]
+
+
+def test_effective_mfu_composes_prediction_with_ratio(tmp_path):
+    from tools import goodput_report
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    with open(art / "perf_pred_test_replicated_bfloat16.json",
+              "w") as f:
+        json.dump({"predicted_step_time_ms": 100.0,
+                   "totals": {"flops": 1.97e13},  # v5e peak x 0.1s
+                   "target": "v5e", "precision": "bfloat16"}, f)
+    mfu = goodput_report.effective_mfu(0.5, str(art))
+    assert mfu["ideal_mfu"] == pytest.approx(1.0)
+    assert mfu["effective_mfu"] == pytest.approx(0.5)
+
+
+def test_run_report_renders_goodput_section(tmp_path):
+    from tools import run_report
+
+    logdir = _two_segment_logdir(tmp_path)
+    report = run_report.render_report(logdir)
+    assert "## Goodput (whole-run wall-clock ledger)" in report
+    assert "between-relaunch downtime" in report
+    # and degrades on an empty logdir
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    report2 = run_report.render_report(str(empty))
+    assert "## Goodput" in report2
+
+
+def test_fit_preregisters_goodput_series():
+    """The PR-4 contract extended: the FIRST scrape of a healthy run
+    must already show the whole badput taxonomy at 0 plus the new
+    flight-event kinds as countable series."""
+    from test_telemetry import parse_openmetrics
+
+    from eksml_tpu.telemetry.exporter import render_openmetrics
+    from eksml_tpu.train import _preregister_core_metrics
+
+    reg = MetricRegistry()
+    _preregister_core_metrics(reg)
+    fams = parse_openmetrics(render_openmetrics(reg))
+    assert fams["eksml_goodput_ratio"]["samples"][
+        "eksml_goodput_ratio"] == 0.0
+    for bucket in BADPUT_BUCKETS:
+        key = f'eksml_badput_seconds_total{{bucket="{bucket}"}}'
+        assert fams["eksml_badput_seconds"]["samples"][key] == 0.0
+    for kind in ("compile_start", "compile_done", "eval_start",
+                 "eval_done"):
+        key = f'eksml_flight_events_total{{kind="{kind}"}}'
+        assert fams["eksml_flight_events"]["samples"][key] == 0.0
+
+
+def test_goodput_knobs_fallback_for_pre_goodput_config():
+    """A config tree predating TELEMETRY.GOODPUT still trains — the
+    knob reader falls back to the canonical defaults dict (the same
+    contract _telemetry_knobs/_tracing_knobs honor)."""
+    from eksml_tpu.config import TELEMETRY_GOODPUT_DEFAULTS
+    from eksml_tpu.train import _goodput_knobs
+
+    class Empty:
+        pass
+
+    knobs = _goodput_knobs(Empty())
+    assert knobs == TELEMETRY_GOODPUT_DEFAULTS
+    from eksml_tpu.config import config as cfg
+
+    assert _goodput_knobs(cfg)["ENABLED"] in (True, False)

@@ -50,3 +50,65 @@ def test_missing_trace_raises(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         summarize(str(tmp_path))
+
+
+# ---- cross-host merge robustness (ISSUE 13 satellite) ----------------
+
+
+def _span(host, step, ts, dur, name="train_step"):
+    return {"ph": "X", "pid": host, "tid": 1, "ts": ts, "dur": dur,
+            "name": name, "args": {"host": host, "step": step}}
+
+
+def test_merge_skips_torn_and_missing_hosts(tmp_path, capsys):
+    """A host killed mid-flush (torn JSON) or before its first flush
+    (no trace at all, but its events file proves it existed) must be
+    SKIPPED WITH A WARNING — not abort the whole cross-host merge,
+    which matters most exactly on such runs."""
+    from tools.trace_summary import merge_host_traces
+
+    good = {"traceEvents": [_span(0, s, s * 1000.0, 400.0)
+                            for s in range(1, 4)]}
+    with open(tmp_path / "trace-host0.json", "w") as f:
+        json.dump(good, f)
+    # torn write: truncated mid-document
+    with open(tmp_path / "trace-host1.json", "w") as f:
+        f.write(json.dumps(good)[:40])
+    # host 2 died before any flush: only its event file exists
+    with open(tmp_path / "events-host2.jsonl", "w") as f:
+        f.write(json.dumps({"time": 1.0, "kind": "run_start",
+                            "host": 2}) + "\n")
+    merged = merge_host_traces(str(tmp_path))
+    assert merged["hosts"] == [0]
+    assert merged["steps_covered"] == 3
+    assert "unreadable" in merged["skipped_hosts"]["1"]
+    assert merged["skipped_hosts"]["2"] == "missing trace-host file"
+    err = capsys.readouterr().err
+    assert "skipping host 1" in err and "skipping host 2" in err
+
+
+def test_merge_malformed_doc_skipped(tmp_path):
+    """Valid JSON that is not a trace document (no traceEvents list)
+    is skipped with a reason, same as a torn file."""
+    from tools.trace_summary import merge_host_traces
+
+    with open(tmp_path / "trace-host0.json", "w") as f:
+        json.dump({"traceEvents": [_span(0, 1, 100.0, 50.0)]}, f)
+    with open(tmp_path / "trace-host1.json", "w") as f:
+        json.dump(["not", "a", "trace"], f)
+    merged = merge_host_traces(str(tmp_path))
+    assert merged["hosts"] == [0]
+    assert "malformed" in merged["skipped_hosts"]["1"]
+
+
+def test_merge_all_torn_still_raises(tmp_path):
+    """With NO readable trace the merge keeps its existing contract:
+    a FileNotFoundError the callers (run_report) already degrade on."""
+    import pytest
+
+    from tools.trace_summary import merge_host_traces
+
+    with open(tmp_path / "trace-host0.json", "w") as f:
+        f.write("{\"traceEvents\": [")
+    with pytest.raises(FileNotFoundError):
+        merge_host_traces(str(tmp_path))

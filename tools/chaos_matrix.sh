@@ -14,6 +14,12 @@
 # mid-run /debugz/profile capture lands Chrome-trace span artifacts,
 # trace_summary --merge names dominant spans, losses stay
 # bit-identical with tracing on.
+# unit-goodput covers the goodput-ledger math (ISSUE 13: bucket
+# classification, restart-gap recovery, reshard boundaries, coarse
+# degradation) and the torn-trace-tolerant cross-host merge;
+# proc-goodput-preempt is the runtime proof: SIGTERM + relaunch, the
+# merged ledger shows nonzero downtime/checkpoint_restore buckets and
+# a wall-clock-consistent ratio, eksml_goodput_ratio scrapes live.
 # unit-lint runs eksml-lint (eksml_tpu/analysis/, ISSUE 8) over the
 # real tree via tests/test_lint.py — the framework-invariant static
 # gate (jit purity, post-override config drift, signal-handler
@@ -60,6 +66,7 @@ RUNGS=(
   "unit-data-robust|tests/test_data_robust.py"
   "unit-telemetry|tests/test_telemetry.py tests/test_run_report.py"
   "unit-tracing|tests/test_tracing.py tests/test_bench_gate.py"
+  "unit-goodput|tests/test_goodput.py tests/test_trace_summary.py"
   "unit-sharding|tests/test_sharding.py"
   "unit-perfgate|tests/test_perf_gate.py"
   "unit-lint|tests/test_lint.py"
@@ -75,6 +82,7 @@ RUNGS=(
   "proc-corrupt-latest|tests/test_fault_tolerance.py::test_corrupt_latest_checkpoint_falls_back"
   "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"
   "proc-debugz-profile|tests/test_fault_tolerance.py::test_debugz_profile_capture_midrun_with_tracing"
+  "proc-goodput-preempt|tests/test_fault_tolerance.py::test_goodput_ledger_across_preempt_relaunch"
   "proc-spmd-collective-skip|tests/test_fault_tolerance.py::test_rank_conditional_collective_skip_hangs_and_lints"
   "proc-lock-inversion|tests/test_fault_tolerance.py::test_lock_inversion_wedges_and_lints"
   "proc-data-chaos|tests/test_fault_tolerance.py::test_data_chaos_train_completes_with_quarantine"

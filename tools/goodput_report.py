@@ -1,0 +1,120 @@
+"""Render the cumulative cross-restart goodput ledger of a logdir.
+
+The live meter (eksml_tpu/telemetry/goodput.py) classifies each
+segment's wall-clock while it runs and banks snapshots to
+``goodput-host<i>.jsonl``; each relaunch starts a new segment.  This
+tool merges everything one logdir accumulated — banked snapshots,
+flight-recorder events, span traces, checkpoint-commit timestamps —
+into ONE whole-run ledger: per-segment bucket tables, the recovered
+between-relaunch ``downtime``, the cumulative goodput ratio, and an
+**effective-MFU** line that composes the banked predicted step time
+(the hermetic roofline, ``artifacts/perf_pred_*.json``) with the
+measured goodput: the MFU the run would report if the hardware number
+were the predicted one — i.e. how much of the remaining headline gap
+is *schedule* (badput) rather than *kernel* speed.
+
+Usage::
+
+    python tools/goodput_report.py <logdir> [--host 0]
+                                   [--out artifacts/goodput_rN.json]
+                                   [--artifacts artifacts/]
+
+Missing artifacts degrade to notes, never errors — like
+run_report.py, this must work on partial evidence (and renders the
+same ledger as run_report's "Goodput" section, through the same
+builder).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def effective_mfu(goodput_ratio: float,
+                  artifacts_dir: str | None = None) -> dict:
+    """Compose the banked roofline prediction with measured goodput.
+
+    ideal MFU = predicted-step flops / predicted step time / peak
+    flops (the MFU of a run with zero badput on the predicted
+    program); effective MFU = ideal × goodput ratio.  Degrades to a
+    note when no prediction artifact (or no chip spec) is available.
+    """
+    if artifacts_dir is None:
+        artifacts_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))), "artifacts")
+    preds = sorted(glob.glob(os.path.join(artifacts_dir,
+                                          "perf_pred_*.json")),
+                   key=os.path.getmtime)
+    if not preds:
+        return {"note": f"no perf_pred_*.json under {artifacts_dir} "
+                        "— run tools/perf_gate.py --update-baseline "
+                        "to bank the roofline predictions"}
+    path = preds[-1]
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        flops = float(rec["totals"]["flops"])
+        pred_ms = float(rec["predicted_step_time_ms"])
+        target = rec.get("target", "")
+        precision = rec.get("precision", "bfloat16")
+        from eksml_tpu.profiling.predict import chip_spec
+
+        spec = chip_spec(target)
+        peak = float(spec["peak_flops"].get(precision)
+                     or spec["peak_flops"]["bfloat16"])
+        ideal = flops / (pred_ms / 1e3) / peak if pred_ms > 0 else 0.0
+    except Exception as e:  # noqa: BLE001 — partial evidence is fine
+        return {"note": f"could not price {os.path.basename(path)}: "
+                        f"{e!r}"}
+    return {
+        "prediction": os.path.basename(path),
+        "target": target,
+        "precision": precision,
+        "ideal_mfu": round(ideal, 4),
+        "goodput_ratio": round(goodput_ratio, 4),
+        "effective_mfu": round(ideal * goodput_ratio, 4),
+        "note": ("effective = ideal (zero-badput roofline MFU of the "
+                 "banked predicted step) x measured goodput ratio — "
+                 "smoke-width lowerings overstate ideal_mfu; compare "
+                 "the ratio's effect, not absolutes"),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("logdir", help="training run directory")
+    p.add_argument("--host", type=int, default=0,
+                   help="host whose event stream segments the ledger "
+                        "(default 0 = coordinator)")
+    p.add_argument("--out", default=None,
+                   help="also write the ledger JSON here (atomic)")
+    p.add_argument("--artifacts", default=None,
+                   help="perf-gate artifact dir for the effective-MFU "
+                        "line (default: <repo>/artifacts)")
+    args = p.parse_args(argv)
+
+    from eksml_tpu.telemetry.goodput import build_ledger
+
+    ledger = build_ledger(args.logdir, host_id=args.host)
+    ledger["effective_mfu"] = effective_mfu(
+        ledger.get("goodput_ratio", 0.0), args.artifacts)
+    print(json.dumps(ledger, indent=1))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(ledger, indent=1) + "\n")
+        os.replace(tmp, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,10 @@ tool folds them into one reviewable report:
 - **Non-finite observations**: rows whose scalars were sanitized to
   ``null`` (the ``*_raw_repr`` satellite), i.e. exactly where the loss
   went bad.
+- **Goodput**: the cumulative cross-restart wall-clock ledger
+  (``eksml_tpu/telemetry/goodput.py``) — per-segment goodput/badput
+  buckets, between-relaunch downtime, and the effective-MFU
+  composition with the banked roofline prediction.
 - **Slow steps**: when the run banked span traces
   (``trace-host<i>.json``, TELEMETRY.TRACING), the cross-host merge
   names the dominant span of each outlier step — "step 412: host 3,
@@ -304,6 +308,77 @@ def _slow_steps_section(logdir: str) -> List[str]:
     return lines
 
 
+def _goodput_section(logdir: str) -> List[str]:
+    """The cumulative cross-restart goodput ledger (ISSUE 13): per-
+    segment bucket tables + the recovered between-relaunch downtime +
+    the effective-MFU composition, via the SAME builder
+    tools/goodput_report.py renders — degrades to a pointer on a
+    logdir that predates the ledger."""
+    lines = ["## Goodput (whole-run wall-clock ledger)"]
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from eksml_tpu.telemetry.goodput import (BADPUT_BUCKETS,
+                                                 build_ledger)
+        ledger = build_ledger(logdir)
+    except Exception as e:  # noqa: BLE001 — partial evidence is fine
+        lines += ["", f"Could not build the goodput ledger: {e!r}"]
+        return lines
+    if not ledger["segments"]:
+        lines += ["", ledger.get("note", "no segments"),
+                  "  (`python tools/goodput_report.py <logdir>` "
+                  "renders the ledger on demand; the live meter "
+                  "publishes `eksml_goodput_ratio` on /metrics and "
+                  "banks `goodput-host<i>.jsonl` while the run is "
+                  "up — knob `TELEMETRY.GOODPUT.ENABLED`.)"]
+        return lines
+    lines += [
+        "",
+        f"{len(ledger['segments'])} segment(s) over "
+        f"{_fmt_num(ledger['total_wall_s'], 6)} s wall; goodput "
+        f"ratio **{ledger['goodput_ratio']}** "
+        f"({_fmt_num(ledger['train_s'], 6)} s train_step; "
+        f"{_fmt_num(ledger['downtime']['total_s'], 6)} s "
+        "between-relaunch downtime).",
+        "",
+        "| segment | started | wall s | steps | mode | goodput s | "
+        "top badput |",
+        "|---|---|---|---|---|---|---|"]
+    for seg in ledger["segments"]:
+        bad = sorted(((b, seg["buckets"][b]) for b in BADPUT_BUCKETS),
+                     key=lambda kv: -kv[1])
+        top = ", ".join(f"{b}={v}" for b, v in bad[:3] if v > 0) or "-"
+        reshard = " (resharded)" if seg.get("resharded") else ""
+        lines.append(
+            f"| {seg['index']}{reshard} | {_ts(seg['start'])} "
+            f"| {seg['wall_s']} | {seg['steps']} | {seg['mode']} "
+            f"| {seg['buckets']['train_step']} | {top} |")
+    merged = ledger["buckets"]
+    lines += ["", "| bucket | seconds | % of wall |", "|---|---|---|"]
+    wall = ledger["total_wall_s"] or 1.0
+    for b, v in sorted(merged.items(), key=lambda kv: -kv[1]):
+        if v <= 0:
+            continue
+        lines.append(f"| {b} | {v} | {round(100 * v / wall, 2)} |")
+    try:
+        try:
+            from tools import goodput_report
+        except ImportError:  # script mode: tools/ is sys.path[0]
+            import goodput_report
+        mfu = goodput_report.effective_mfu(ledger["goodput_ratio"])
+    except Exception as e:  # noqa: BLE001 — partial evidence is fine
+        mfu = {"note": f"effective-MFU unavailable: {e!r}"}
+    if "effective_mfu" in mfu:
+        lines += ["",
+                  f"Effective MFU: **{mfu['effective_mfu']}** = "
+                  f"ideal {mfu['ideal_mfu']} "
+                  f"(`{mfu['prediction']}`, {mfu['target']}) × "
+                  f"goodput {mfu['goodput_ratio']}."]
+    else:
+        lines += ["", f"Effective MFU: {mfu['note']}"]
+    return lines
+
+
 def _attribution_section(logdir: str,
                          attribution: Optional[str]) -> List[str]:
     path = attribution or os.path.join(logdir, "profile",
@@ -565,6 +640,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.extend(_events_section(events, max_events))
     lines.append("")
     lines.extend(_elastic_section(events))
+    lines.append("")
+    lines.extend(_goodput_section(logdir))
     lines.append("")
     lines.extend(_slow_steps_section(logdir))
     lines.append("")

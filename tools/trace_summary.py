@@ -191,27 +191,56 @@ def summarize(trace_dir: str, top_n: int = 15,
 STEP_SPAN = "train_step"  # the per-step anchor span the fit loop emits
 
 
-def load_host_traces(logdir: str) -> dict:
-    """{host_id: [events]} from every ``trace-host<i>.json`` under
-    ``logdir``."""
+def load_host_traces(logdir: str) -> tuple:
+    """``({host_id: [events]}, {host_id: reason})`` from every
+    ``trace-host<i>.json`` under ``logdir``.
+
+    Skip-and-warn, never abort: a host killed mid-flush leaves a
+    truncated/torn trace file, and a host that died before its first
+    flush leaves none at all — exactly the runs whose cross-host
+    timeline matters most.  Unreadable files are skipped with a
+    stderr warning; hosts that the run's ``events-host<i>.jsonl``
+    files prove existed but that left no trace are reported missing.
+    Only a logdir with NO readable trace at all raises."""
     out: dict = {}
+    skipped: dict = {}
     for path in sorted(glob.glob(
             os.path.join(logdir, "trace-host*.json"))):
         m = re.search(r"trace-host(\d+)\.json$", path)
         if not m:
             continue
+        host = int(m.group(1))
         try:
             with open(path) as f:
-                events = json.load(f).get("traceEvents", [])
-        except (json.JSONDecodeError, OSError):
-            continue  # torn write from a killed process
-        out[int(m.group(1))] = events
+                doc = json.load(f)
+            events = doc.get("traceEvents", []) \
+                if isinstance(doc, dict) else None
+        except (json.JSONDecodeError, OSError) as e:
+            # torn write from a killed process — keep the other hosts
+            skipped[host] = f"unreadable ({type(e).__name__}: {e})"
+            continue
+        if not isinstance(events, list):
+            skipped[host] = "malformed (no traceEvents list)"
+            continue
+        out[host] = events
+    # hosts the run demonstrably had (their event files exist) but
+    # whose span trace never landed — name them instead of silently
+    # rendering a timeline that pretends they weren't there
+    for path in glob.glob(os.path.join(logdir, "events-host*.jsonl")):
+        m = re.search(r"events-host(\d+)\.jsonl$", path)
+        if m and int(m.group(1)) not in out \
+                and int(m.group(1)) not in skipped:
+            skipped[int(m.group(1))] = "missing trace-host file"
+    for host in sorted(skipped):
+        print(f"warning: skipping host {host}: {skipped[host]} — "
+              "merging the remaining hosts", file=sys.stderr)
     if not out:
         raise FileNotFoundError(
-            f"no trace-host<i>.json under {logdir!r} — run with "
-            "TELEMETRY.TRACING.ENABLED=True (or trigger a "
-            "/debugz/profile capture) first")
-    return out
+            f"no readable trace-host<i>.json under {logdir!r} — run "
+            "with TELEMETRY.TRACING.ENABLED=True (or trigger a "
+            "/debugz/profile capture) first"
+            + (f"; skipped: {skipped}" if skipped else ""))
+    return out, skipped
 
 
 def _step_anchors(events) -> dict:
@@ -238,7 +267,7 @@ def merge_host_traces(logdir: str, slow_top: int = 5) -> dict:
     median offset IS the clock skew; wall-clock (NTP) disagreement
     drops out entirely.
     """
-    traces = load_host_traces(logdir)
+    traces, skipped = load_host_traces(logdir)
     ref_host = min(traces)
     ref_anchor = _step_anchors(traces[ref_host])
 
@@ -313,6 +342,8 @@ def merge_host_traces(logdir: str, slow_top: int = 5) -> dict:
 
     return {
         "hosts": sorted(traces),
+        "skipped_hosts": {str(h): r
+                          for h, r in sorted(skipped.items())},
         "host_offsets_us": {str(h): round(o, 1)
                             for h, o in offsets.items()},
         "steps_covered": len(steps),
