@@ -599,6 +599,14 @@ class ValuesConfigSyncChecker:
         findings: List[Finding] = []
         for chart in rc.CHARTS:
             values_rel = f"{chart}/values.yaml"
+            # per-chart layout from the resolver's own spec table
+            # (tools/render_charts.py CHART_SPECS): the main
+            # template/values key is "maskrcnn" for the training
+            # charts and "serve" for the serving chart — ONE table
+            # teaches the golden render and this checker together
+            spec = getattr(rc, "CHART_SPECS", {}).get(
+                chart, {"main": "maskrcnn"})
+            main = spec.get("main", "maskrcnn")
             try:
                 rendered = rc.render_chart(chart)
             except Exception as e:  # noqa: BLE001
@@ -608,16 +616,17 @@ class ValuesConfigSyncChecker:
                     context=f"render {chart}"))
                 continue
             main_doc = rendered.get(f"{os.path.basename(chart)}"
-                                    f"__maskrcnn.yaml")
+                                    f"__{main}.yaml")
             if main_doc is None:
-                # a chart whose main template isn't maskrcnn.yaml
-                # (e.g. a future serving chart) degrades to a finding
-                # like the other failure paths, never a crash
+                # a chart whose layout the spec table doesn't
+                # describe degrades to a finding like the other
+                # failure paths, never a crash
                 findings.append(Finding(
                     self.rule, values_rel, 0,
-                    "chart renders no <chart>__maskrcnn.yaml main "
+                    f"chart renders no <chart>__{main}.yaml main "
                     "manifest — teach values-config-sync this "
-                    "chart's layout",
+                    "chart's layout (tools/render_charts.py "
+                    "CHART_SPECS)",
                     context=f"layout {chart}"))
                 continue
             for key in self._rendered_config_keys(yaml, main_doc):
@@ -640,7 +649,7 @@ class ValuesConfigSyncChecker:
                         "sync the template/values with config.py",
                         context=ctx))
             findings.extend(self._dead_values_keys(
-                yaml, repo_root, chart))
+                yaml, repo_root, chart, values_key=main))
         return findings
 
     @staticmethod
@@ -704,7 +713,8 @@ class ValuesConfigSyncChecker:
                 walk(doc)
         return keys
 
-    def _dead_values_keys(self, yaml, repo_root: str, chart: str
+    def _dead_values_keys(self, yaml, repo_root: str, chart: str,
+                          values_key: str = "maskrcnn"
                           ) -> List[Finding]:
         values_rel = f"{chart}/values.yaml"
         values_abs = os.path.join(repo_root, values_rel)
@@ -717,22 +727,23 @@ class ValuesConfigSyncChecker:
             values_src = f.read()
         values = yaml.safe_load(values_src)
         out = []
-        for key in (values.get("maskrcnn") or {}):
+        for key in (values.get(values_key) or {}):
             # \b: `chips` must not count as referenced just because
             # `chips_per_host` is (prefix keys exist in both charts)
-            if re.search(r"\.Values\.maskrcnn\." + re.escape(key)
-                         + r"\b", template_text):
+            if re.search(r"\.Values\." + re.escape(values_key) + r"\."
+                         + re.escape(key) + r"\b", template_text):
                 continue
-            lineno, ctx = 0, f"maskrcnn.{key}:"
+            lineno, ctx = 0, f"{values_key}.{key}:"
             for i, line in enumerate(values_src.splitlines(), start=1):
                 if line.strip().startswith(f"{key}:"):
                     lineno, ctx = i, line.strip()
                     break
             out.append(Finding(
                 self.rule, values_rel, lineno,
-                f"values key maskrcnn.{key} is never referenced by "
-                "the chart templates — dead knob (operators setting "
-                "it silently change nothing); wire it or drop it",
+                f"values key {values_key}.{key} is never referenced "
+                "by the chart templates — dead knob (operators "
+                "setting it silently change nothing); wire it or "
+                "drop it",
                 context=ctx))
         return out
 

@@ -133,6 +133,22 @@ def _parse_value(text: str, existing: Any) -> Any:
     return value
 
 
+def knobs_with_defaults(node, defaults: dict) -> dict:
+    """Config-node values over canonical defaults, for callers handed
+    a config tree predating the knobs — ONE implementation of the
+    fallback merge every subsystem uses (loader ``_data_knobs``,
+    sharding, trainer telemetry/tracing/goodput, serve engine).  The
+    ``to_dict`` guard keeps an unfrozen AttrDict's materialized empty
+    sub-nodes from shadowing a scalar default."""
+    out = dict(defaults)
+    if node is not None:
+        for k in out:
+            v = getattr(node, k, None)
+            if v is not None and not hasattr(v, "to_dict"):
+                out[k] = v
+    return out
+
+
 config = AttrDict()
 _C = config  # shorthand used below, TensorPack-style
 
@@ -297,6 +313,45 @@ TELEMETRY_TRACING_DEFAULTS = dict(
 TELEMETRY_GOODPUT_DEFAULTS = dict(
     ENABLED=True,
     BANK=True,
+)
+
+# Online-serving knobs (eksml_tpu/serve/) — ONE source of truth, same
+# pattern as RESILIENCE_DATA_DEFAULTS: installed under SERVE, and
+# serve.engine/serve.batcher import the same dict as the fallback for
+# pre-serving config trees.
+#
+# - PORT: the serving HTTP port (POST /v1/predict + /healthz +
+#   /metrics on one listener); charts/serve renders the containerPort,
+#   the probes AND the --config SERVE.PORT argv from one values key.
+#   0 = bind an ephemeral port and publish it to --port-file (the
+#   load-test discovery contract, same as TELEMETRY.PORT=0).
+# - MAX_BATCH_SIZE: requests per micro-batch ceiling.  The dispatcher
+#   closes a batch at this size even before the delay window expires.
+# - MAX_BATCH_DELAY_MS: how long the dispatcher holds an open batch
+#   waiting for same-bucket requests.  0 = pass-through mode: every
+#   request dispatches alone, immediately (the latency-floor
+#   configuration; throughput configurations trade a few ms here for
+#   batch occupancy).
+# - MAX_QUEUE: bounded request queue; a full queue answers 429 (load
+#   shedding at admission, never unbounded memory).
+# - BATCH_SIZES: the executable batch rungs warmed at startup; every
+#   dispatched batch pads up to the smallest rung that holds it so
+#   the (bucket, batch) pair always hits the AOT cache.  () = (1,
+#   MAX_BATCH_SIZE) deduped.  Every rung must be <= MAX_BATCH_SIZE.
+# - BUCKETS: (H, W) canvases for request padding (assign_bucket's
+#   schedule, dims divisible by the coarsest FPN stride).  () = fall
+#   back to PREPROC.BUCKETS, else the square (MAX_SIZE, MAX_SIZE).
+# - RESULT_MASKS: include RLE instance masks in /v1/predict responses
+#   by default (per-request `masks` field still overrides); mask
+#   pasting is host-side postprocess cost, so the default is off.
+SERVE_DEFAULTS = dict(
+    PORT=8081,
+    MAX_BATCH_SIZE=4,
+    MAX_BATCH_DELAY_MS=5.0,
+    MAX_QUEUE=256,
+    BATCH_SIZES=(),
+    BUCKETS=(),
+    RESULT_MASKS=False,
 )
 
 
@@ -541,6 +596,12 @@ def _define_defaults() -> None:
     for k, v in TELEMETRY_GOODPUT_DEFAULTS.items():
         setattr(_C.TELEMETRY.GOODPUT, k, v)
 
+    # ---- online serving (eksml_tpu/serve/) --------------------------
+    # Dynamic micro-batching inference server; per-knob docs on
+    # SERVE_DEFAULTS above.
+    for k, v in SERVE_DEFAULTS.items():
+        setattr(_C.SERVE, k, v)
+
     _C.freeze()
 
 
@@ -607,6 +668,29 @@ def finalize_configs(is_training: bool) -> AttrDict:
                     bh, bw)
     if isinstance(_C.DATA.TRAIN, str):
         _C.DATA.TRAIN = (_C.DATA.TRAIN,)
+
+    # ---- serving (eksml_tpu/serve/) ---------------------------------
+    serve_buckets = _C.SERVE.BUCKETS or ()
+    if (len(serve_buckets) == 2
+            and all(isinstance(b, int) for b in serve_buckets)):
+        # SERVE.BUCKETS=((832,1344)) parses as a flat 2-int tuple —
+        # same operator-intent fixup as PREPROC.BUCKETS above
+        serve_buckets = (tuple(serve_buckets),)
+        _C.SERVE.BUCKETS = serve_buckets
+    for b in serve_buckets:
+        assert isinstance(b, (tuple, list)) and len(b) == 2 and all(
+            int(d) % max(_C.FPN.ANCHOR_STRIDES) == 0 for d in b), (
+            f"SERVE bucket {b!r}: must be an (H, W) pair with dims "
+            "divisible by the coarsest FPN stride")
+    assert int(_C.SERVE.MAX_BATCH_SIZE) >= 1, _C.SERVE.MAX_BATCH_SIZE
+    if isinstance(_C.SERVE.BATCH_SIZES, int):
+        # SERVE.BATCH_SIZES=(4) parses as a bare int — the operator
+        # meant a single rung
+        _C.SERVE.BATCH_SIZES = (_C.SERVE.BATCH_SIZES,)
+    for bs in (_C.SERVE.BATCH_SIZES or ()):
+        assert 1 <= int(bs) <= int(_C.SERVE.MAX_BATCH_SIZE), (
+            f"SERVE.BATCH_SIZES rung {bs} must lie in "
+            f"[1, SERVE.MAX_BATCH_SIZE={_C.SERVE.MAX_BATCH_SIZE}]")
 
     if is_training:
         # Reference couples steps/epoch to world size: 120000/N at batch

@@ -40,18 +40,12 @@ def _data_knobs(cfg) -> Dict:
     """RESILIENCE.DATA values with fallbacks for callers that hand the
     loader a config tree predating the robustness knobs — defaults are
     the canonical ``RESILIENCE_DATA_DEFAULTS`` (one source of truth)."""
-    from eksml_tpu.config import RESILIENCE_DATA_DEFAULTS
+    from eksml_tpu.config import (RESILIENCE_DATA_DEFAULTS,
+                                  knobs_with_defaults)
 
-    out = dict(RESILIENCE_DATA_DEFAULTS)
-    node = getattr(getattr(cfg, "RESILIENCE", None), "DATA", None)
-    if node is not None:
-        for k in out:
-            v = getattr(node, k, None)
-            # hasattr guard: an unfrozen AttrDict materializes missing
-            # keys as empty nodes instead of raising
-            if v is not None and not hasattr(v, "to_dict"):
-                out[k] = v
-    return out
+    return knobs_with_defaults(
+        getattr(getattr(cfg, "RESILIENCE", None), "DATA", None),
+        RESILIENCE_DATA_DEFAULTS)
 
 
 def quantize_uint8(image_f: np.ndarray) -> np.ndarray:

@@ -275,18 +275,13 @@ def publish_state_byte_gauges(params, opt_state) -> Tuple[int, int]:
 
 def sharding_knobs(cfg) -> Dict[str, Any]:
     """``TRAIN.SHARDING.*`` values over the canonical defaults —
-    config trees predating the knobs keep working (the
-    ``_knobs_with_fallback`` pattern, train.py)."""
-    from eksml_tpu.config import SHARDING_DEFAULTS
+    config trees predating the knobs keep working (the shared
+    ``knobs_with_defaults`` merge, config.py)."""
+    from eksml_tpu.config import SHARDING_DEFAULTS, knobs_with_defaults
 
-    out = dict(SHARDING_DEFAULTS)
-    node = getattr(getattr(cfg, "TRAIN", None), "SHARDING", None)
-    if node is not None and hasattr(node, "to_dict"):
-        for k in out:
-            v = getattr(node, k, None)
-            if v is not None and not hasattr(v, "to_dict"):
-                out[k] = v
-    return out
+    return knobs_with_defaults(
+        getattr(getattr(cfg, "TRAIN", None), "SHARDING", None),
+        SHARDING_DEFAULTS)
 
 
 def _divisors(n: int) -> List[int]:

@@ -6,6 +6,14 @@ Reference flow being replaced (viz notebook, cells 7/9/11/23):
   cell 11  OfflinePredictor(PredictConfig(model, get_model_loader(ckpt),
              input/output names))                   → OfflinePredictor
   cell 23  predict_image(img, predictor)            → predict_image
+
+Since the serving subsystem landed (eksml_tpu/serve/), the default
+single-image path routes through the SAME bucket-padded AOT executable
+cache the online server dispatches (serve/engine.py): the image pads
+to ``assign_bucket``'s canvas and the compiled program is reused
+across calls AND shape variations — the historical per-novel-shape
+``jax.jit`` recompile is gone.  ``legacy_jit=True`` keeps the original
+square-pad jit path for bit-parity against pre-serving goldens.
 """
 
 from __future__ import annotations
@@ -31,11 +39,79 @@ class DetectionResult:
     mask: Optional[np.ndarray] = None   # full-image uint8, or None
 
 
+def restore_predict_params(cfg, model, logdir: str,
+                           step: Optional[int] = None):
+    """Restore the params subtree of a saved TrainState, rebuilding the
+    state skeleton the Trainer checkpoints (train.py).  ONE definition
+    for the notebook predictor and the serving engine — both must load
+    exactly what the trainer saved."""
+    from eksml_tpu.data.loader import make_synthetic_batch
+    from eksml_tpu.train import TrainState, make_optimizer
+    from eksml_tpu.utils import CheckpointManager
+
+    ckpt = CheckpointManager(logdir)
+    step = ckpt.latest_step() if step is None else step
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {logdir}")
+    log.info("restoring checkpoint step %d from %s", step, logdir)
+    batch = make_synthetic_batch(cfg, batch_size=1, image_size=128)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()
+             if k not in ("image_scale", "image_id")}
+    rng = jax.random.PRNGKey(0)
+    params = jax.eval_shape(
+        lambda: model.init(rng, batch, rng)["params"])
+    params = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), params)
+    tx, _ = make_optimizer(cfg)
+    skeleton = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params), rng=rng)
+    restored = ckpt.restore(skeleton, step=step)
+    return restored.params
+
+
+def detections_from_raw(out_i: Dict[str, np.ndarray], scale: float,
+                        h: int, w: int, thresh: float,
+                        want_masks: bool = True
+                        ) -> List[DetectionResult]:
+    """Per-image raw predict outputs (resized coordinates) → sorted
+    :class:`DetectionResult` list in ORIGINAL-image coordinates.  ONE
+    postprocess for the notebook predictor and the serving batcher so
+    batch-of-N and single-image results can be compared bitwise.
+
+    ``out_i`` holds one image's rows: boxes [D,4], scores [D],
+    classes [D], valid [D] and optionally masks [D,mr,mr].
+    """
+    from eksml_tpu.data.masks import paste_mask
+
+    results: List[DetectionResult] = []
+    for i in range(out_i["boxes"].shape[0]):
+        if out_i["valid"][i] <= 0 or out_i["scores"][i] < thresh:
+            continue
+        box = out_i["boxes"][i] / scale
+        box = np.clip(box, 0, [w, h, w, h]).astype(np.float32)
+        mask = None
+        if want_masks and "masks" in out_i:
+            mask = paste_mask(out_i["masks"][i], box, h, w)
+        results.append(DetectionResult(
+            box=box, score=float(out_i["scores"][i]),
+            class_id=int(out_i["classes"][i]), mask=mask))
+    results.sort(key=lambda r: -r.score)
+    return results
+
+
 class OfflinePredictor:
-    """Builds the jitted predict function once; call repeatedly."""
+    """Builds the predict function once; call repeatedly.
+
+    Default path: the serving engine's bucket-padded AOT executable
+    cache (one compiled program per (bucket, batch-rung), shared shape
+    space with the online server).  ``legacy_jit=True``: the original
+    per-canvas ``jax.jit`` square-pad path, kept for bit-parity tests
+    against pre-serving goldens.
+    """
 
     def __init__(self, cfg, params=None, checkpoint_dir: Optional[str] = None,
-                 checkpoint_step: Optional[int] = None):
+                 checkpoint_step: Optional[int] = None,
+                 legacy_jit: bool = False):
         from eksml_tpu.models import MaskRCNN
 
         self.cfg = cfg
@@ -43,42 +119,27 @@ class OfflinePredictor:
         if params is None:
             if not checkpoint_dir:
                 raise ValueError("need params or checkpoint_dir")
-            params = self._restore_params(checkpoint_dir, checkpoint_step)
+            params = restore_predict_params(cfg, self.model,
+                                            checkpoint_dir,
+                                            checkpoint_step)
         self.params = params
-        self._predict = jax.jit(
-            lambda p, images, hw: self.model.apply(
-                {"params": p}, images, hw, method=MaskRCNN.predict))
+        self.legacy_jit = bool(legacy_jit)
+        self._engine = None
+        if self.legacy_jit:
+            self._predict = jax.jit(
+                lambda p, images, hw: self.model.apply(
+                    {"params": p}, images, hw, method=MaskRCNN.predict))
+        else:
+            from eksml_tpu.serve.engine import InferenceEngine
+
+            # lazy compile (warm=False): a notebook predicting one
+            # image pays one compile at that image's bucket, not the
+            # server's full bucket×batch warmup matrix
+            self._engine = InferenceEngine(cfg, params=self.params,
+                                           model=self.model)
 
         self.mean = np.asarray(cfg.PREPROC.PIXEL_MEAN, np.float32)
         self.std = np.asarray(cfg.PREPROC.PIXEL_STD, np.float32)
-
-    # -- checkpoint ----------------------------------------------------
-
-    def _restore_params(self, logdir: str, step: Optional[int]):
-        """Restore the params subtree of a saved TrainState, rebuilding
-        the state skeleton the Trainer checkpoints (train.py)."""
-        from eksml_tpu.data.loader import make_synthetic_batch
-        from eksml_tpu.train import TrainState, make_optimizer
-        from eksml_tpu.utils import CheckpointManager
-
-        ckpt = CheckpointManager(logdir)
-        step = ckpt.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {logdir}")
-        log.info("restoring checkpoint step %d from %s", step, logdir)
-        batch = make_synthetic_batch(self.cfg, batch_size=1, image_size=128)
-        batch = {k: jnp.asarray(v) for k, v in batch.items()
-                 if k not in ("image_scale", "image_id")}
-        rng = jax.random.PRNGKey(0)
-        params = jax.eval_shape(
-            lambda: self.model.init(rng, batch, rng)["params"])
-        params = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), params)
-        tx, _ = make_optimizer(self.cfg)
-        skeleton = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                              opt_state=tx.init(params), rng=rng)
-        restored = ckpt.restore(skeleton, step=step)
-        return restored.params
 
     # -- prediction ----------------------------------------------------
 
@@ -105,6 +166,14 @@ class OfflinePredictor:
         .ipynb cells 11, 16 fetch named output tensors and post-process
         by hand); ``__call__`` is the high-level path the tensorpack
         notebook uses."""
+        if self._engine is not None:
+            # bucket-padded AOT path: canvas = assign_bucket's bucket,
+            # executable shared with the online server's cache
+            canvas, scale, (nh, nw), bucket = \
+                self._engine.preprocess(image)
+            hw = np.asarray([nh, nw], np.float32)
+            out = self._engine.infer(canvas[None], hw[None], bucket)
+            return out, scale
         im, scale, (nh, nw) = self._preprocess(image)
         # Clip to the resized content extent, not the padded canvas —
         # matches the eval path (evalcoco/runner.py) so both produce
@@ -117,27 +186,14 @@ class OfflinePredictor:
     def __call__(self, image: np.ndarray,
                  score_thresh: Optional[float] = None
                  ) -> List[DetectionResult]:
-        """Single-image inference in original coordinates."""
-        from eksml_tpu.data.masks import paste_mask
-
+        """Single-image inference in original coordinates (detections
+        un-padded/un-scaled back from the bucket canvas)."""
         h, w = image.shape[:2]
         out, scale = self.raw(image)
         thresh = (self.cfg.TEST.RESULT_SCORE_THRESH
                   if score_thresh is None else score_thresh)
-        results = []
-        for i in range(out["boxes"].shape[1]):
-            if out["valid"][0, i] <= 0 or out["scores"][0, i] < thresh:
-                continue
-            box = out["boxes"][0, i] / scale
-            box = np.clip(box, 0, [w, h, w, h]).astype(np.float32)
-            mask = None
-            if "masks" in out:
-                mask = paste_mask(out["masks"][0, i], box, h, w)
-            results.append(DetectionResult(
-                box=box, score=float(out["scores"][0, i]),
-                class_id=int(out["classes"][0, i]), mask=mask))
-        results.sort(key=lambda r: -r.score)
-        return results
+        return detections_from_raw(
+            {k: v[0] for k, v in out.items()}, scale, h, w, thresh)
 
 
 def predict_image(img: np.ndarray,

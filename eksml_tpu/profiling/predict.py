@@ -368,6 +368,57 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
     return hlo, meta
 
 
+def lower_predict_step(cfg, batch_size: int,
+                       pad_hw: Tuple[int, int]
+                       ) -> Tuple[str, Dict[str, Any]]:
+    """AOT-lower + compile the real PREDICT step at one serving
+    (bucket, batch) rung; → (hlo_text, meta).
+
+    The same program construction the serving engine warms
+    (eksml_tpu/serve/engine.py: ``jit(model.apply(…, method=predict))
+    .lower(...).compile()``), so the priced program is the program the
+    server dispatches.  Params are abstract (``ShapeDtypeStruct`` via
+    ``eval_shape``) — nothing is materialized, only compiled; runs on
+    any backend (the gate runs it under ``JAX_PLATFORMS=cpu``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from eksml_tpu.models import MaskRCNN
+
+    model = MaskRCNN.from_config(cfg)
+    bh, bw = int(pad_hw[0]), int(pad_hw[1])
+    img_dtype = (jnp.uint8
+                 if getattr(cfg.PREPROC, "DEVICE_NORMALIZE", False)
+                 else jnp.float32)
+    imgs = jax.ShapeDtypeStruct((batch_size, bh, bw, 3), img_dtype)
+    hw = jax.ShapeDtypeStruct((batch_size, 2), jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(
+        lambda r, im, h: model.init(r, im, h,
+                                    method=MaskRCNN.predict),
+        rng, imgs, hw)
+    params = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
+        shapes["params"])
+
+    fn = jax.jit(lambda p, im, h: model.apply(
+        {"params": p}, im, h, method=MaskRCNN.predict))
+    hlo = fn.lower(params, imgs, hw).compile().as_text()
+    meta = {
+        "kind": "predict",
+        "batch_size": int(batch_size),
+        "pad_hw": [bh, bw],
+        "precision": str(cfg.TRAIN.PRECISION),
+        "device_normalize": bool(getattr(cfg.PREPROC,
+                                         "DEVICE_NORMALIZE", False)),
+        # single-device inference program: no collectives to price
+        "comm_sizes": {},
+        "mesh_shape": {},
+    }
+    return hlo, meta
+
+
 # ---- prediction comparison (the gate's FAIL logic) ------------------
 
 

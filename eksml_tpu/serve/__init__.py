@@ -1,0 +1,38 @@
+"""Online inference serving (ISSUE 14).
+
+The paper's capability set ends at "helm install launches a training
+job"; this package is the serving half of the north star — the same
+Mask-RCNN behind a production-shaped HTTP front-end:
+
+    HTTP POST /v1/predict ──▶ MicroBatcher (bounded queue, dynamic
+    (serve/server.py)          micro-batches under SERVE.MAX_BATCH_
+                               DELAY_MS / MAX_BATCH_SIZE)
+                                 │  requests padded into the bucket
+                                 ▼  schedule (data/loader.assign_bucket)
+                               InferenceEngine (serve/engine.py):
+                               pre-warmed AOT executable per
+                               (bucket, batch-rung) — ZERO compiles on
+                               the request path after warmup
+                                 │
+                                 ▼
+                               postprocess → DetectionResult JSON
+
+Telemetry rides the existing registry/exporter: ``eksml_serve_*``
+latency histograms, queue-depth / in-flight / batch-occupancy gauges,
+per-request spans (queue_wait / pad / device_infer / postprocess).
+``/healthz`` reports 503 until warmup completes and again while
+draining; SIGTERM stops admission, flushes in-flight batches, then
+exits 0 (the PR 1 preemption discipline applied to serving).
+
+Deployment: ``charts/serve`` (Deployment + Service + HPA driven by
+the exporter's queue-depth metric); load testing + artifact banking:
+``tools/serve_loadtest.py``; hermetic predicted-latency CI signal:
+``tools/perf_gate.py --serve``.
+"""
+
+from eksml_tpu.serve.batcher import (DrainingError,  # noqa: F401
+                                     MicroBatcher, QueueFullError,
+                                     ServeError)
+from eksml_tpu.serve.engine import (InferenceEngine,  # noqa: F401
+                                    batch_rungs, bucket_schedule)
+from eksml_tpu.serve.server import ServingServer  # noqa: F401

@@ -1,0 +1,154 @@
+"""``python -m eksml_tpu.serve`` — run the online inference server.
+
+Lifecycle::
+
+    finalize_configs(is_training=False)      # the notebooks' cell 9
+      → InferenceEngine(checkpoint | random params)
+      → ServingServer.start()                # /healthz answers 503
+      → engine.warmup()                      # all bucket×rung AOT
+      → mark_ready()                         # /healthz flips to 200
+      → wait for SIGTERM/SIGINT
+      → drain: stop admission, flush in-flight batches, exit 0
+
+Usage::
+
+    python -m eksml_tpu.serve --checkpoint-dir /efs/run/train_log \\
+        --config SERVE.MAX_BATCH_SIZE=8 SERVE.MAX_BATCH_DELAY_MS=5
+
+    # smoke/load-test mode: random params, ephemeral port
+    python -m eksml_tpu.serve --random-params --port 0 \\
+        --port-file /tmp/serve.port --config <smoke overrides>
+
+The charts/serve Deployment renders exactly this argv; the SIGTERM
+drain is what makes a rolling update or node preemption lose ZERO
+accepted requests (readiness flips 503 first, so the Service stops
+routing while the flush runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+log = logging.getLogger("eksml_tpu.serve")
+
+
+def _random_params(cfg, model, buckets):
+    """Initialize params from the PRNG at the smallest bucket — the
+    hermetic smoke/load-test path (no checkpoint required)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    bh, bw = buckets[0]
+    dtype = (jnp.uint8 if getattr(cfg.PREPROC, "DEVICE_NORMALIZE",
+                                  False) else jnp.float32)
+    images = jnp.zeros((1, bh, bw, 3), dtype)
+    hw = jnp.asarray([[bh, bw]], np.float32)
+    init = jax.jit(lambda r: model.init(
+        r, images, hw, method=type(model).predict))
+    return init(jax.random.PRNGKey(0))["params"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m eksml_tpu.serve",
+        description=__doc__.splitlines()[0])
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="training logdir to restore params from "
+                        "(latest step unless --step)")
+    p.add_argument("--step", type=int, default=None,
+                   help="explicit checkpoint step")
+    p.add_argument("--random-params", action="store_true",
+                   help="PRNG-initialized params (smoke/load tests; "
+                        "no checkpoint needed)")
+    p.add_argument("--port", type=int, default=None,
+                   help="HTTP port (default: config SERVE.PORT; "
+                        "0 = ephemeral + --port-file discovery)")
+    p.add_argument("--addr", default="0.0.0.0")
+    p.add_argument("--port-file", default=None,
+                   help="publish the bound port here "
+                        "(write-then-rename)")
+    p.add_argument("--trace-file", default=None,
+                   help="flush the span ring (queue_wait/pad/"
+                        "device_infer/postprocess) here as Chrome-"
+                        "trace JSON at drain; requires "
+                        "TELEMETRY.TRACING.ENABLED=True")
+    p.add_argument("--config", nargs="*", default=[],
+                   metavar="KEY=VALUE",
+                   help="dotted config overrides (the chart-rendered "
+                        "UX)")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if not args.random_params and not args.checkpoint_dir:
+        p.error("need --checkpoint-dir or --random-params")
+
+    from eksml_tpu.config import config, finalize_configs
+    from eksml_tpu.models import MaskRCNN
+    from eksml_tpu.serve.batcher import MicroBatcher
+    from eksml_tpu.serve.engine import InferenceEngine, bucket_schedule
+    from eksml_tpu.serve.server import ServingServer
+    from eksml_tpu.utils.compile_cache import enable_persistent_cache
+
+    config.freeze(False)
+    config.update_args(args.config)
+    cfg = finalize_configs(is_training=False)
+    enable_persistent_cache()
+
+    tracer = None
+    if bool(cfg.TELEMETRY.TRACING.ENABLED):
+        # the request-lifecycle spans (queue_wait / pad / device_infer
+        # / postprocess) join the same Chrome-trace timeline the
+        # training side flushes; without a tracer installed the span
+        # API is a true no-op
+        from eksml_tpu.telemetry.tracing import Tracer, install_tracer
+
+        tracer = Tracer(capacity=int(cfg.TELEMETRY.TRACING.RING_EVENTS),
+                        path=args.trace_file, enabled=True)
+        install_tracer(tracer)
+
+    model = MaskRCNN.from_config(cfg)
+    if args.random_params:
+        params = _random_params(cfg, model, bucket_schedule(cfg))
+        engine = InferenceEngine(cfg, params=params, model=model)
+    else:
+        engine = InferenceEngine(cfg, checkpoint_dir=args.checkpoint_dir,
+                                 checkpoint_step=args.step, model=model)
+    batcher = MicroBatcher(engine, cfg)
+    port = args.port if args.port is not None else int(cfg.SERVE.PORT)
+    server = ServingServer(
+        batcher, port=port, addr=args.addr, port_file=args.port_file,
+        result_masks_default=bool(cfg.SERVE.RESULT_MASKS))
+
+    # SIGTERM/SIGINT → drain.  Handler only sets an Event (the
+    # preemption-layer discipline: no locks, no I/O in signal context).
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — signal API
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    server.start()
+    n = engine.warmup()
+    server.mark_ready()
+    log.info("ready: %d warm executable(s) over %d bucket(s) x %s "
+             "batch rung(s) on port %d", n, len(engine.buckets),
+             engine.rungs, server.port)
+    stop.wait()
+    log.info("signal received: draining")
+    server.drain()
+    if tracer is not None and args.trace_file:
+        tracer.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

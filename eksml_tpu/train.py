@@ -138,17 +138,13 @@ def make_optimizer(cfg):
 
 
 def _knobs_with_fallback(node, defaults: Dict[str, Any]) -> Dict[str, Any]:
-    """Config-node values over canonical defaults, for callers that
-    hand the trainer a config tree predating the knobs (same pattern
-    as the loader's ``_data_knobs``) — the defaults dict stays the one
-    source of truth; sub-trees (``to_dict``) never shadow a scalar."""
-    out = dict(defaults)
-    if node is not None:
-        for k in out:
-            v = getattr(node, k, None)
-            if v is not None and not hasattr(v, "to_dict"):
-                out[k] = v
-    return out
+    """Config-node values over canonical defaults — now the shared
+    ``knobs_with_defaults`` merge hoisted to config.py (loader,
+    sharding and the serve engine call the same implementation);
+    kept as a thin alias for this module's callers."""
+    from eksml_tpu.config import knobs_with_defaults
+
+    return knobs_with_defaults(node, defaults)
 
 
 def _telemetry_knobs(cfg) -> Dict[str, Any]:

@@ -1231,3 +1231,131 @@ def test_lock_inversion_wedges_and_lints(tmp_path):
                           capture_output=True, text=True, timeout=60)
     assert done.returncode == 0, done.stdout + done.stderr
     assert "ALL DONE" in done.stdout
+
+
+# ---- rung: serving drain under load (ISSUE 14) -----------------------
+
+SERVE_TINY = TINY_MODEL_OVERRIDES + [
+    "PREPROC.TEST_SHORT_EDGE_SIZE=128",
+    "SERVE.BATCH_SIZES=(1,4)", "SERVE.MAX_BATCH_DELAY_MS=5",
+    "SERVE.MAX_QUEUE=64",
+]
+
+
+@pytest.mark.slow
+def test_serve_drain_under_load(tmp_path, compile_cache):
+    """proc-serve-drain: a live ``python -m eksml_tpu.serve`` under
+    ``tools/serve_loadtest.py`` traffic takes SIGTERM mid-load.
+    Contract (the PR 1 preemption discipline applied to serving):
+    ZERO accepted in-flight requests dropped, new requests answered
+    503 (or refused once the listener closed), clean exit 0 — and the
+    mid-run ``/metrics`` scrape parses as strict OpenMetrics with the
+    full ``eksml_serve_*`` family set present."""
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_loadtest
+
+    from test_telemetry import parse_openmetrics
+
+    port_file = str(tmp_path / "serve.port")
+    log_path = str(tmp_path / "serve.log")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "JAX_COMPILATION_CACHE_DIR": compile_cache})
+    cmd = [sys.executable, "-m", "eksml_tpu.serve", "--random-params",
+           "--port", "0", "--port-file", port_file,
+           "--addr", "127.0.0.1", "--config"] + SERVE_TINY
+    with open(log_path, "w") as logf:
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+    try:
+        deadline = time.time() + 900
+        while not os.path.exists(port_file):
+            assert proc.poll() is None, (
+                "server died before binding:\n"
+                + open(log_path).read()[-3000:])
+            assert time.time() < deadline, "port file never appeared"
+            time.sleep(0.2)
+        url = f"http://127.0.0.1:{open(port_file).read().strip()}"
+        serve_loadtest.wait_ready(url, budget=900)
+
+        # background load: enough requests that SIGTERM lands mid-run
+        result = {}
+
+        def load():
+            result["art"] = serve_loadtest.run_load(
+                url, requests=80, concurrency=4,
+                sizes="100x80,80x100,128x96", timeout=60)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+
+        # mid-run: wait for real traffic, then scrape /metrics and
+        # strict-parse the serve family set
+        mid_scrape = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            body = urllib.request.urlopen(
+                url + "/metrics", timeout=30).read().decode()
+            ok = serve_loadtest.metric_value(
+                body, "eksml_serve_requests_total",
+                '{outcome="ok"}')
+            if ok and ok >= 10:
+                mid_scrape = body
+                break
+            time.sleep(0.2)
+        assert mid_scrape is not None, "no serving traffic within 120s"
+        fams = parse_openmetrics(mid_scrape)
+        for name in ("eksml_serve_requests", "eksml_serve_batches",
+                     "eksml_serve_request_latency_ms",
+                     "eksml_serve_queue_wait_ms",
+                     "eksml_serve_infer_ms",
+                     "eksml_serve_queue_depth",
+                     "eksml_serve_in_flight",
+                     "eksml_serve_batch_occupancy",
+                     "eksml_serve_aot_compiles",
+                     "eksml_serve_request_path_compiles",
+                     "eksml_serve_warm_executables"):
+            assert name in fams, f"missing {name} in mid-run scrape"
+        assert serve_loadtest.metric_value(
+            mid_scrape, "eksml_serve_aot_compiles_total") == 2.0
+        assert serve_loadtest.metric_value(
+            mid_scrape,
+            "eksml_serve_request_path_compiles_total") == 0.0
+
+        # SIGTERM mid-load: drain
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=180)
+        assert not t.is_alive(), "load generator never finished"
+        rc = proc.wait(timeout=120)
+        assert rc == 0, ("drain did not exit cleanly (rc=%s):\n%s"
+                         % (rc, open(log_path).read()[-3000:]))
+
+        art = result["art"]
+        # zero dropped in-flight requests: every request either
+        # completed with a full response, or was REJECTED at/after
+        # drain start (503) or hit the closed listener (URLError) —
+        # never a timeout or a half-written answer
+        assert art["completed"] + art["errors"] == 80
+        assert art["completed"] >= 10
+        for err in art["error_samples"]:
+            assert ("503" in err or "Connection refused" in err
+                    or "Connection reset" in err
+                    or "URLError" in err or "RemoteDisconnected"
+                    in err), f"unexpected failure mode: {err}"
+        # the accepted ones all carry the full span breakdown
+        for ph in ("queue_wait", "pad", "device_infer",
+                   "postprocess"):
+            assert art["phase_ms"][ph]["mean"] is not None
+        log_text = open(log_path).read()
+        assert "drain: admission closed" in log_text
+        assert "drain complete" in log_text
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

@@ -21,8 +21,8 @@ from tools import render_charts
 
 GOLDEN_FILES = sorted(
     f"{os.path.basename(chart)}__{name}.yaml"
-    for chart in render_charts.CHARTS
-    for name in ("maskrcnn",) + render_charts.SUBCHARTS)
+    for chart, spec in render_charts.CHART_SPECS.items()
+    for name in (spec["main"],) + tuple(spec["subcharts"]))
 
 
 def test_rendered_manifests_match_committed_goldens():
@@ -96,6 +96,50 @@ def test_golden_jobset_contract():
     rules = job["podFailurePolicy"]["rules"]
     assert rules[0]["onExitCodes"]["values"] == \
         [vals["preempt_exit_code"]]
+
+
+def test_golden_serve_contract():
+    """The serving chart's rendered manifests are coherent end-to-end:
+    the ONE port value reaches containerPort, probes, Service
+    targetPort, the scrape annotation AND the --config argv; the HPA
+    targets the Deployment and scales on the exporter's queue-depth
+    series; readiness rides the warmup-gated /healthz."""
+    with open(os.path.join(REPO, render_charts.GOLDEN_DIR,
+                           "serve__serve.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f.read()) if d]
+    vals = yaml.safe_load(open(os.path.join(
+        REPO, "charts/serve/values.yaml")))["serve"]
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    svc = next(d for d in docs if d["kind"] == "Service")
+    hpa = next(d for d in docs
+               if d["kind"] == "HorizontalPodAutoscaler")
+    pod = dep["spec"]["template"]
+    c = pod["spec"]["containers"][0]
+    port = vals["port"]
+    assert c["ports"][0]["containerPort"] == port
+    assert pod["metadata"]["annotations"]["prometheus.io/port"] == \
+        str(port)
+    assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    # liveness must NOT ride /healthz: a draining pod answers 503
+    # there and must not be killed mid-flush
+    assert c["livenessProbe"]["httpGet"]["path"] == "/metrics"
+    assert svc["spec"]["ports"][0]["targetPort"] == port
+    argv = c["command"]
+    assert f"SERVE.PORT={port}" in argv
+    assert f"SERVE.MAX_BATCH_SIZE={vals['max_batch_size']}" in argv
+    assert f"SERVE.MAX_QUEUE={vals['max_queue']}" in argv
+    assert hpa["spec"]["scaleTargetRef"]["name"] == \
+        dep["metadata"]["name"]
+    assert hpa["spec"]["minReplicas"] == \
+        vals["hpa"]["min_replicas"] == dep["spec"]["replicas"]
+    assert hpa["spec"]["maxReplicas"] == vals["hpa"]["max_replicas"]
+    metric = hpa["spec"]["metrics"][0]["pods"]
+    assert metric["metric"]["name"] == "eksml_serve_queue_depth"
+    assert metric["target"]["averageValue"] == \
+        str(vals["hpa"]["target_queue_depth"])
+    # TPU resources on 1-chip inference pods
+    assert c["resources"]["limits"]["google.com/tpu"] == \
+        vals["chips_per_pod"] == 1
 
 
 def test_engine_fail_surfaces_values_errors():

@@ -422,6 +422,45 @@ def test_values_sync_flags_unknown_key_and_dead_value(chart_repo):
     assert len(keys) == len(set(keys))
 
 
+def test_values_sync_resolves_serve_chart(chart_repo):
+    """PR 8 round 3 made the checker *degrade gracefully* for a
+    non-maskrcnn chart; now that charts/serve exists the checker must
+    actually RESOLVE its layout (render_charts.CHART_SPECS) — a clean
+    tree yields neither a layout finding nor an unknown-key finding
+    for the serve chart."""
+    r = run_lint(targets=["tools"], repo_root=str(chart_repo),
+                 rules=["values-config-sync"])
+    serve = [f for f in r.findings if "charts/serve" in f.path]
+    assert serve == [], serve
+
+
+def test_values_sync_flags_serve_typo_and_dead_key(chart_repo):
+    """Both drift directions pinned on the SERVE chart: a rendered
+    --config key config.py doesn't know (the pod dies at start), and
+    a values.yaml key the template never references (dead knob)."""
+    tpl = (chart_repo / "charts" / "serve" / "templates"
+           / "serve.yaml")
+    tpl.write_text(tpl.read_text().replace(
+        "- SERVE.MAX_QUEUE={{ int .Values.serve.max_queue }}",
+        "- SERVE.MAX_QUEUE_TYPO={{ int .Values.serve.max_queue }}"))
+    vals = chart_repo / "charts" / "serve" / "values.yaml"
+    vals.write_text(vals.read_text().replace(
+        "  port: 8081",
+        "  port: 8081\n  dead_serve_knob: 1"))
+    r = run_lint(targets=["tools"], repo_root=str(chart_repo),
+                 rules=["values-config-sync"])
+    typo = [f for f in r.findings
+            if "SERVE.MAX_QUEUE_TYPO" in f.message]
+    dead = [f for f in r.findings
+            if "serve.dead_serve_knob" in f.message]
+    assert typo and dead, r.findings
+    assert typo[0].path == "charts/serve/templates/serve.yaml"
+    assert typo[0].line > 0
+    assert "SERVE.MAX_QUEUE_TYPO=" in typo[0].context
+    assert dead[0].path == "charts/serve/values.yaml"
+    assert dead[0].line > 0 and "dead_serve_knob" in dead[0].context
+
+
 # ---------------------------------------------------------------------
 # suppression + baseline semantics
 # ---------------------------------------------------------------------

@@ -713,3 +713,66 @@ def test_synthetic_regression_real_lowering(tmp_path, fresh_config):
     # the message names a regressing component, not a bare number
     assert any(c in row["error"] for c in
                ("roi", "fpn", "backbone", "rpn")), row["error"]
+
+
+# ---- serving gate (--serve, ISSUE 14) --------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_lowering():
+    """ONE serve-rung lowering (b1 at the 128 smoke bucket) shared by
+    the serve-gate tests — module-scoped like tiny_lowering so the
+    compile is paid once.  predict_serve_rung mutates the global
+    config (the CLI owns the process), so snapshot/restore here."""
+    from eksml_tpu import config as config_mod
+
+    saved = config_mod.config.to_dict()
+    try:
+        fresh = perf_gate.predict_serve_rung(
+            "serve_128x128_b1", "bfloat16", "v5e")
+        probe = perf_gate.predict_serve_rung(
+            "serve_128x128_b1", "bfloat16", "v5e",
+            config_overrides=["FPN.NUM_CHANNEL=64"])
+    finally:
+        config_mod.config.freeze(False)
+        config_mod.config.from_dict(saved)
+        config_mod.config.freeze()
+    return fresh, probe
+
+
+def test_serve_rung_prices_predict_step(serve_lowering):
+    """--serve lowers the SERVING predict program (no bwd, no
+    optimizer, no collectives) and frames the number as per-bucket
+    latency."""
+    fresh, _ = serve_lowering
+    assert fresh["key"] == "serve_128x128_b1_bfloat16"
+    assert fresh["kind"] == "predict"
+    assert fresh["predicted_latency_ms"] == \
+        fresh["predicted_step_time_ms"] > 0
+    assert fresh["predicted_latency_per_image_ms"] == pytest.approx(
+        fresh["predicted_latency_ms"], abs=1e-3)  # batch 1
+    # inference program: forward-only, nothing rides bwd/optimizer/
+    # comms
+    s = fresh["sections_ms"]
+    assert s["bwd"] == 0.0 and s["optimizer"] == 0.0
+    assert s["comms"] == 0.0
+    assert "backbone" in fresh["components_ms"]
+    assert fresh["geometry"]["pad_hw"] == [128, 128]
+
+
+def test_serve_rung_vs_committed_baseline_and_probe(serve_lowering):
+    """Fresh serve lowering PASSes against the committed
+    perf_pred_serve_* bank; the injected FPN.NUM_CHANNEL probe FAILs
+    with a component-attributed message — the rc=1 acceptance
+    criterion, pinned at artifact level."""
+    fresh, probe = serve_lowering
+    bank = os.path.join(REPO, "artifacts")
+    row = perf_gate.gate_one(fresh, bank, max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "PASS", row
+    row2 = perf_gate.gate_one(probe, bank, max_regress_pct=10.0,
+                              allow_missing_baseline=False)
+    assert row2["gate"] == "FAIL"
+    assert "regressed" in row2["error"]
+    # the message names the worst component, never a bare number
+    assert "predicted +" in row2["error"]

@@ -255,3 +255,72 @@ def test_concurrency_crosslink_section(tmp_path, monkeypatch):
     row_g = [ln for ln in text.splitlines()
              if "lock-order: eksml_tpu/train.py:7" in ln][0]
     assert "**yes**" not in row_g
+
+
+def test_serving_section_renders_banked_rounds(tmp_path):
+    """The Serving section (ISSUE 14): latency/throughput table from
+    banked serve_r<N>.json artifacts plus the span-derived
+    slowest-request attribution; degrades to a pointer when the
+    subsystem was never load-tested."""
+    art_dir = str(tmp_path / "artifacts")
+    os.makedirs(art_dir)
+    # degraded: no artifacts -> pointer, never a crash
+    report = run_report.render_report(str(tmp_path / "run"),
+                                      artifacts_dir=art_dir)
+    assert "No `serve_r<N>.json` artifacts" in report
+    with open(os.path.join(art_dir, "serve_r1.json"), "w") as f:
+        json.dump({
+            "kind": "serve_loadtest", "mode": "closed",
+            "completed": 200, "concurrency": 8,
+            "images_per_sec": 41.5, "images_per_sec_per_chip": 41.5,
+            "latency_ms": {"p50": 120.0, "p99": 310.0},
+            "batch_occupancy_mean": 0.81,
+            "engine": {"request_path_compiles": 0},
+            "phase_ms": {
+                "queue_wait": {"mean": 4.0, "p99": 22.0},
+                "pad": {"mean": 1.1, "p99": 3.0},
+                "device_infer": {"mean": 95.0, "p99": 180.0},
+                "postprocess": {"mean": 0.4, "p99": 1.2}},
+            "slowest": [{"idx": 7, "total_ms": 311.2,
+                         "dominant_phase": "device_infer",
+                         "phases": {"queue_wait": 20.0,
+                                    "device_infer": 280.0},
+                         "bucket": [832, 1344],
+                         "batch_fill": 3, "batch_rung": 4}],
+        }, f)
+    report = run_report.render_report(str(tmp_path / "run"),
+                                      artifacts_dir=art_dir)
+    assert "## Serving (load-tested latency / throughput)" in report
+    assert "serve_r1.json" in report
+    assert "| 120.0 | 310.0 |" in report      # p50 / p99
+    assert "**device_infer**" in report       # slowest attribution
+    assert "832x1344" in report
+    assert "| queue_wait | 4.0 | 22.0 |" in report
+
+
+def test_effective_mfu_skips_serve_predictions(tmp_path):
+    """Satellite: goodput_report's effective-MFU pairing must skip
+    perf_pred_serve_* artifacts — a serving (inference) roofline
+    composed with a TRAINING goodput ratio would be nonsense."""
+    from tools import goodput_report
+
+    art_dir = str(tmp_path / "artifacts")
+    os.makedirs(art_dir)
+    with open(os.path.join(art_dir,
+                           "perf_pred_serve_128x128_b1_bfloat16.json"),
+              "w") as f:
+        json.dump({"predicted_step_time_ms": 2.4, "target": "v5e",
+                   "totals": {"flops": 1e9}}, f)
+    out = goodput_report.effective_mfu(0.9, art_dir)
+    # ONLY a serve prediction present -> degrade to the pointer note,
+    # never price the inference program against training goodput
+    assert "note" in out and "effective_mfu" not in out
+    with open(os.path.join(
+            art_dir, "perf_pred_128_b1_replicated_bfloat16.json"),
+            "w") as f:
+        json.dump({"predicted_step_time_ms": 100.0, "target": "v5e",
+                   "precision": "bfloat16",
+                   "totals": {"flops": 1e12}}, f)
+    out = goodput_report.effective_mfu(0.9, art_dir)
+    assert out.get("prediction") == \
+        "perf_pred_128_b1_replicated_bfloat16.json"

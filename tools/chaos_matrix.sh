@@ -41,9 +41,19 @@
 # counterpart: a two-thread A→B / B→A lock inversion provably wedges
 # under a test timeout while the SAME source lints to the lock-order
 # finding at the same lines — again one bug, proven once.
+# unit-serve covers the online serving subsystem (ISSUE 14,
+# eksml_tpu/serve/): AOT bucket-cache warmup with a zero-request-path-
+# compile counter, batch-of-N bit-identical to padded sequential
+# singles, bucket force-fit, MAX_BATCH_DELAY_MS=0 pass-through,
+# warmup-gated /healthz, graceful drain, and the load generator's
+# artifact math.  proc-serve-drain is the runtime proof: a live
+# `python -m eksml_tpu.serve` under tools/serve_loadtest.py traffic
+# takes SIGTERM mid-load — zero dropped in-flight requests, 503 on
+# new ones, clean exit 0, and the mid-run /metrics scrape parses the
+# eksml_serve_* family set as strict OpenMetrics.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
-# processes and are marked slow (excluded from tier-1); the unit and
-# data-* rungs run in seconds.  Everything runs under
+# (or `-m eksml_tpu.serve`) processes and are marked slow (excluded
+# from tier-1); the unit and data-* rungs run in seconds.  Everything runs under
 # JAX_PLATFORMS=cpu with the tiny-model overrides, sharing ONE XLA
 # compile via the module-scoped cache.
 #
@@ -69,6 +79,7 @@ RUNGS=(
   "unit-goodput|tests/test_goodput.py tests/test_trace_summary.py"
   "unit-sharding|tests/test_sharding.py"
   "unit-perfgate|tests/test_perf_gate.py"
+  "unit-serve|tests/test_serve.py"
   "unit-lint|tests/test_lint.py"
   "unit-lint-spmd|tests/test_lint_spmd.py"
   "unit-lint-concurrency|tests/test_lint_concurrency.py"
@@ -85,6 +96,7 @@ RUNGS=(
   "proc-goodput-preempt|tests/test_fault_tolerance.py::test_goodput_ledger_across_preempt_relaunch"
   "proc-spmd-collective-skip|tests/test_fault_tolerance.py::test_rank_conditional_collective_skip_hangs_and_lints"
   "proc-lock-inversion|tests/test_fault_tolerance.py::test_lock_inversion_wedges_and_lints"
+  "proc-serve-drain|tests/test_fault_tolerance.py::test_serve_drain_under_load"
   "proc-data-chaos|tests/test_fault_tolerance.py::test_data_chaos_train_completes_with_quarantine"
   "proc-data-breaker|tests/test_fault_tolerance.py::test_quarantine_overflow_aborts_actionably"
 )

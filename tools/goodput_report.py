@@ -53,6 +53,11 @@ def effective_mfu(goodput_ratio: float,
     preds = sorted(glob.glob(os.path.join(artifacts_dir,
                                           "perf_pred_*.json")),
                    key=os.path.getmtime)
+    # serving predictions (perf_pred_serve_*, tools/perf_gate.py
+    # --serve) price the INFERENCE step — pairing one with a training
+    # run's goodput ratio would compose the wrong program's roofline
+    preds = [p for p in preds if not os.path.basename(p)
+             .startswith("perf_pred_serve_")]
     if not preds:
         return {"note": f"no perf_pred_*.json under {artifacts_dir} "
                         "— run tools/perf_gate.py --update-baseline "

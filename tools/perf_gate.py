@@ -78,6 +78,18 @@ PRED_RUNGS: Dict[str, Dict[str, Any]] = {
 DEFAULT_RUNGS = "128_b1,256_b1"
 DEFAULT_STRATEGIES = "replicated,fsdp"
 
+# Serving (bucket, batch) rungs priced by --serve: the PREDICT step
+# the serving engine's AOT cache warms (eksml_tpu/serve/engine.py),
+# lowered at SMOKE widths like the training rungs — CI gets a
+# per-bucket predicted-latency verdict with no hardware and no
+# tunnel.  Names mirror the serve bucket schedule at smoke geometry.
+SERVE_PRED_RUNGS: Dict[str, Dict[str, Any]] = {
+    "serve_128x128_b1": {"pad_hw": (128, 128), "batch_size": 1},
+    "serve_128x128_b4": {"pad_hw": (128, 128), "batch_size": 4},
+}
+
+DEFAULT_SERVE_RUNGS = "serve_128x128_b1,serve_128x128_b4"
+
 
 def pred_key(rung: str, strategy: str, precision: str) -> str:
     return f"{rung}_{strategy}_{precision}"
@@ -162,6 +174,61 @@ def predict_rung(rung: str, strategy: str, precision: str,
     return rec
 
 
+def _serve_rung_config(rung: str, precision: str, config_overrides):
+    """Global config → the serve rung's inference geometry at SMOKE
+    widths, finalized for inference (``is_training=False`` — the
+    server's own finalize call)."""
+    from eksml_tpu.config import (SMOKE_OVERRIDES, config,
+                                  finalize_configs)
+
+    spec = SERVE_PRED_RUNGS[rung]
+    size = max(spec["pad_hw"])
+    config.freeze(False)
+    config.update_args(SMOKE_OVERRIDES)
+    config.TRAIN.PRECISION = precision
+    config.PREPROC.MAX_SIZE = size
+    config.PREPROC.TEST_SHORT_EDGE_SIZE = min(spec["pad_hw"])
+    config.TEST.EVAL_BATCH_SIZE = spec["batch_size"]
+    config.update_args(config_overrides or [])
+    return finalize_configs(is_training=False)
+
+
+def predict_serve_rung(rung: str, precision: str, target: str,
+                       config_overrides=None) -> Dict[str, Any]:
+    """Lower one serving (bucket, batch) rung's PREDICT step and
+    price it for ``target`` — the per-bucket predicted-latency record
+    the --serve gate compares and banks."""
+    from eksml_tpu.profiling import predict as P
+
+    spec = SERVE_PRED_RUNGS[rung]
+    cfg = _serve_rung_config(rung, precision, config_overrides)
+    # cfg wins over the flag (the bench.py re-derivation rule): a
+    # --config TRAIN.PRECISION override changed the lowered program
+    precision = str(cfg.TRAIN.PRECISION)
+    t0 = time.time()
+    hlo, meta = P.lower_predict_step(
+        cfg, batch_size=spec["batch_size"], pad_hw=spec["pad_hw"])
+    pred = P.predict_from_hlo(hlo, target=target, precision=precision,
+                              comm_sizes=meta["comm_sizes"])
+    rec = dict(pred)
+    rec.update({
+        "rung": rung,
+        "key": f"{rung}_{precision}",
+        "kind": "predict",
+        "geometry": {k: meta[k] for k in ("batch_size", "pad_hw",
+                                          "device_normalize")},
+        # the serving SLO framing of the same number: predicted
+        # device time for ONE dispatched (bucket, batch) executable
+        "predicted_latency_ms": pred["predicted_step_time_ms"],
+        "predicted_latency_per_image_ms": round(
+            pred["predicted_step_time_ms"] / spec["batch_size"], 4),
+        "model_widths": "smoke",
+        "lower_seconds": round(time.time() - t0, 1),
+        "banked_at": _utcnow(),
+    })
+    return rec
+
+
 def gate_one(fresh: Dict, bank_dir: str, max_regress_pct: float,
              allow_missing_baseline: bool) -> Dict[str, Any]:
     """Fresh prediction vs its banked baseline → one result row."""
@@ -223,6 +290,14 @@ def main(argv=None) -> int:
     p.add_argument("--calibrate-only", action="store_true",
                    help="skip lowering; print the calibration report "
                         "from banked artifacts (pure JSON math)")
+    p.add_argument("--serve", action="store_true",
+                   help="gate the SERVING predict step instead of the "
+                        "train step: lower each (bucket, batch) rung "
+                        "of the serve engine's AOT cache and price "
+                        "its latency (perf_pred_serve_* baselines)")
+    p.add_argument("--serve-rungs", default=DEFAULT_SERVE_RUNGS,
+                   help=f"comma list of {sorted(SERVE_PRED_RUNGS)} "
+                        f"[%(default)s]")
     p.add_argument("--out", default=None,
                    help="write the verdict JSON here too")
     p.add_argument("--config", nargs="*", default=[],
@@ -265,54 +340,74 @@ def main(argv=None) -> int:
     ok = True
     run_precision = args.precision
     if not args.calibrate_only:
-        rungs = [r.strip() for r in args.rungs.split(",") if r.strip()]
-        strategies = [s.strip() for s in args.strategies.split(",")
-                      if s.strip()]
-        bad = [r for r in rungs if r not in PRED_RUNGS]
-        if bad:
-            p.error(f"unknown rung(s) {bad}; known: "
-                    f"{sorted(PRED_RUNGS)}")
-        for rung in rungs:
-            for strategy in strategies:
-                print(f"perf_gate: lowering {rung} x {strategy} ...",
-                      file=sys.stderr)
+        if args.serve:
+            verdict["mode"] = "serve"
+            rungs = [r.strip() for r in args.serve_rungs.split(",")
+                     if r.strip()]
+            bad = [r for r in rungs if r not in SERVE_PRED_RUNGS]
+            if bad:
+                p.error(f"unknown serve rung(s) {bad}; known: "
+                        f"{sorted(SERVE_PRED_RUNGS)}")
+            # one (rung,) pseudo-strategy axis: the predict program
+            # has no sharding strategy — serving is per-replica
+            plan = [(rung, None) for rung in rungs]
+        else:
+            rungs = [r.strip() for r in args.rungs.split(",")
+                     if r.strip()]
+            strategies = [s.strip() for s in args.strategies.split(",")
+                          if s.strip()]
+            bad = [r for r in rungs if r not in PRED_RUNGS]
+            if bad:
+                p.error(f"unknown rung(s) {bad}; known: "
+                        f"{sorted(PRED_RUNGS)}")
+            plan = [(rung, strategy) for rung in rungs
+                    for strategy in strategies]
+        for rung, strategy in plan:
+            print(f"perf_gate: lowering {rung}"
+                  + (f" x {strategy}" if strategy else " (serve)")
+                  + " ...", file=sys.stderr)
+            if strategy is None:
+                fresh = predict_serve_rung(
+                    rung, args.precision, args.target,
+                    config_overrides=args.config)
+            else:
                 fresh = predict_rung(
                     rung, strategy, args.precision, args.target,
                     fsdp_axis=args.fsdp_axis,
                     config_overrides=args.config)
-                # the record's key, NOT pred_key(..., args.precision):
-                # a --config TRAIN.PRECISION override re-keyed the
-                # record, and writing it under the flag's key would
-                # overwrite the wrong baseline file
-                key = fresh["key"]
-                run_precision = fresh["precision"]
-                print(f"perf_gate: {key}: predicted "
-                      f"{fresh['predicted_step_time_ms']}ms "
-                      f"(lowered in {fresh['lower_seconds']}s)",
-                      file=sys.stderr)
-                if args.fresh_dir:
-                    os.makedirs(args.fresh_dir, exist_ok=True)
-                    # atomic: bench_gate --predicted may poll this
-                    # dir while we lower the next rung
-                    atomic_write_json(os.path.join(
-                        args.fresh_dir, f"perf_pred_{key}.json"),
-                        fresh)
-                if args.update_baseline:
-                    os.makedirs(args.bank_dir, exist_ok=True)
-                    path = baseline_path(args.bank_dir, key)
-                    atomic_write_json(path, fresh)
-                    verdict["results"].append({
-                        "key": key, "gate": "BANKED",
-                        "predicted_step_time_ms":
-                            fresh["predicted_step_time_ms"],
-                        "sections_ms": fresh["sections_ms"],
-                        "baseline_path": os.path.relpath(path, REPO)})
-                else:
-                    row = gate_one(fresh, args.bank_dir,
-                                   args.max_regress_pct,
-                                   args.allow_missing_baseline)
-                    ok = ok and row["gate"] != "FAIL"
-                    verdict["results"].append(row)
+            # the record's key, NOT pred_key(..., args.precision):
+            # a --config TRAIN.PRECISION override re-keyed the
+            # record, and writing it under the flag's key would
+            # overwrite the wrong baseline file
+            key = fresh["key"]
+            run_precision = fresh["precision"]
+            print(f"perf_gate: {key}: predicted "
+                  f"{fresh['predicted_step_time_ms']}ms "
+                  f"(lowered in {fresh['lower_seconds']}s)",
+                  file=sys.stderr)
+            if args.fresh_dir:
+                os.makedirs(args.fresh_dir, exist_ok=True)
+                # atomic: bench_gate --predicted may poll this
+                # dir while we lower the next rung
+                atomic_write_json(os.path.join(
+                    args.fresh_dir, f"perf_pred_{key}.json"),
+                    fresh)
+            if args.update_baseline:
+                os.makedirs(args.bank_dir, exist_ok=True)
+                path = baseline_path(args.bank_dir, key)
+                atomic_write_json(path, fresh)
+                verdict["results"].append({
+                    "key": key, "gate": "BANKED",
+                    "predicted_step_time_ms":
+                        fresh["predicted_step_time_ms"],
+                    "sections_ms": fresh["sections_ms"],
+                    "baseline_path": os.path.relpath(path, REPO)})
+            else:
+                row = gate_one(fresh, args.bank_dir,
+                               args.max_regress_pct,
+                               args.allow_missing_baseline)
+                ok = ok and row["gate"] != "FAIL"
+                verdict["results"].append(row)
 
     # the honesty check rides every run: how far can the model's
     # ratios be trusted, per the banked hardware evidence.

@@ -32,7 +32,20 @@ import sys
 import yaml
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CHARTS = ("charts/maskrcnn", "charts/maskrcnn-optimized")
+# Per-chart layout: the main template/values key (training charts are
+# "maskrcnn", the serving chart is "serve") plus any subcharts.  The
+# values-config-sync lint (eksml_tpu/analysis/checkers.py) reads this
+# table too, so a new chart teaches BOTH the golden render and the
+# --config key resolution in one place.
+CHART_SPECS = {
+    "charts/maskrcnn": {"main": "maskrcnn",
+                        "subcharts": ("tensorboard", "jupyter")},
+    "charts/maskrcnn-optimized": {"main": "maskrcnn",
+                                  "subcharts": ("tensorboard",
+                                                "jupyter")},
+    "charts/serve": {"main": "serve", "subcharts": ()},
+}
+CHARTS = tuple(CHART_SPECS)
 SUBCHARTS = ("tensorboard", "jupyter")
 GOLDEN_DIR = os.path.join("charts", "golden")
 # pinned render identity: goldens must be byte-stable
@@ -46,6 +59,8 @@ GOLDEN_VALUES = {
                           "eksml-train:golden"},
     "jupyter": {"image": "REGION-docker.pkg.dev/PROJECT/eksml/"
                          "eksml-viz:golden"},
+    "serve": {"image": "REGION-docker.pkg.dev/PROJECT/eksml/"
+                       "eksml-train:golden"},
 }
 
 
@@ -410,19 +425,28 @@ def _read(rel):
 
 def render_chart(chart: str) -> dict:
     """{golden filename: rendered text} for one chart dir."""
+    spec = CHART_SPECS.get(chart,
+                           {"main": "maskrcnn",
+                            "subcharts": SUBCHARTS})
+    main = spec["main"]
     values = _merge(yaml.safe_load(_read(f"{chart}/values.yaml")),
-                    {"maskrcnn": GOLDEN_VALUES["maskrcnn"]})
-    helpers_src = _read(f"{chart}/templates/_helpers.tpl")
-    helper_nodes, _, _ = _parse(_tokenize(helpers_src))
-    helpers = {n[1]: n[2] for n in helper_nodes if n[0] == "define"}
+                    {main: GOLDEN_VALUES.get(main, {})})
+    helpers = {}
+    helpers_path = os.path.join(REPO, chart, "templates",
+                                "_helpers.tpl")
+    if os.path.exists(helpers_path):
+        helper_nodes, _, _ = _parse(_tokenize(
+            _read(f"{chart}/templates/_helpers.tpl")))
+        helpers = {n[1]: n[2] for n in helper_nodes
+                   if n[0] == "define"}
 
     out = {}
     base = os.path.basename(chart)
     eng = Engine({"Values": values, "Release": {"Name": RELEASE}},
                  helpers)
-    out[f"{base}__maskrcnn.yaml"] = eng.render(
-        _read(f"{chart}/templates/maskrcnn.yaml"))
-    for sub in SUBCHARTS:
+    out[f"{base}__{main}.yaml"] = eng.render(
+        _read(f"{chart}/templates/{main}.yaml"))
+    for sub in spec["subcharts"]:
         sub_vals = yaml.safe_load(_read(f"{chart}/charts/{sub}/values.yaml"))
         sub_vals = _merge(sub_vals, GOLDEN_VALUES.get(sub, {}))
         sub_vals["global"] = values["global"]

@@ -554,6 +554,121 @@ def _concurrency_section(logdir: str) -> List[str]:
     return lines
 
 
+def _serving_section(artifacts_dir: Optional[str]) -> List[str]:
+    """Serving latency/throughput from the banked load-test artifacts
+    (``serve_r<N>.json``, tools/serve_loadtest.py) plus the
+    span-derived slowest-request attribution the load generator
+    recorded — degrades to a pointer when the serving subsystem has
+    never been load-tested."""
+    lines = ["## Serving (load-tested latency / throughput)"]
+    if artifacts_dir is None:
+        artifacts_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))), "artifacts")
+    numbered = []
+    for p in glob.glob(os.path.join(artifacts_dir, "serve_r*.json")):
+        m = re.match(r"serve_r(\d+)\.json$", os.path.basename(p))
+        if m:  # stray serve_r*.json names degrade to ignored, never
+            numbered.append((int(m.group(1)), p))  # crash the report
+    paths = [p for _, p in sorted(numbered)]
+    if not paths:
+        lines += ["", "No `serve_r<N>.json` artifacts in "
+                      f"`{artifacts_dir}` — start the server "
+                      "(`python -m eksml_tpu.serve`) and bank a "
+                      "round with `python tools/serve_loadtest.py "
+                      "--bank`."]
+        lines.extend(_serve_predicted_lines(artifacts_dir))
+        return lines
+    lines += ["",
+              f"{len(paths)} banked round(s):", "",
+              "| round | mode | req | conc | p50 ms | p99 ms | "
+              "img/s | img/s/chip | occupancy | compiles after "
+              "warmup |",
+              "|---|---|---|---|---|---|---|---|---|---|"]
+    latest = None
+    for path in paths:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            lines.append(f"| {os.path.basename(path)} | "
+                         f"unreadable: {e!r} | | | | | | | | |")
+            continue
+        latest = rec
+        lat = rec.get("latency_ms", {})
+        rpc = (rec.get("engine") or {}).get("request_path_compiles")
+        lines.append(
+            f"| {os.path.basename(path)} | {rec.get('mode', '-')} "
+            f"| {rec.get('completed', '-')} "
+            f"| {rec.get('concurrency', '-')} "
+            f"| {lat.get('p50', '-')} | {lat.get('p99', '-')} "
+            f"| {rec.get('images_per_sec', '-')} "
+            f"| {rec.get('images_per_sec_per_chip', '-')} "
+            f"| {rec.get('batch_occupancy_mean', '-')} "
+            f"| {'**' + str(rpc) + '**' if rpc else rpc} |")
+    if latest is None:
+        return lines
+    phases = latest.get("phase_ms", {})
+    if phases:
+        lines += ["", "Latest round's phase attribution "
+                      "(span-derived, per request):", "",
+                  "| phase | mean ms | p99 ms |", "|---|---|---|"]
+        for ph in ("queue_wait", "pad", "device_infer",
+                   "postprocess"):
+            row = phases.get(ph) or {}
+            lines.append(f"| {ph} | {row.get('mean', '-')} "
+                         f"| {row.get('p99', '-')} |")
+    slowest = latest.get("slowest") or ()
+    if slowest:
+        lines += ["", "Slowest requests (dominant span named — the "
+                      "tail is attributable, not a bare number):", "",
+                  "| req | total ms | dominant span | queue_wait | "
+                  "device_infer | bucket | fill/rung |",
+                  "|---|---|---|---|---|---|---|"]
+        for s in slowest[:5]:
+            ph = s.get("phases", {})
+            bucket = s.get("bucket")
+            lines.append(
+                f"| {s.get('idx', '-')} "
+                f"| {round(s.get('total_ms', 0), 1)} "
+                f"| **{s.get('dominant_phase', '-')}** "
+                f"| {ph.get('queue_wait', '-')} "
+                f"| {ph.get('device_infer', '-')} "
+                f"| {'x'.join(str(b) for b in bucket) if bucket else '-'} "
+                f"| {s.get('batch_fill', '-')}/"
+                f"{s.get('batch_rung', '-')} |")
+    lines.extend(_serve_predicted_lines(artifacts_dir))
+    return lines
+
+
+def _serve_predicted_lines(artifacts_dir: str) -> List[str]:
+    """The hermetic per-bucket predicted-latency bank
+    (``perf_pred_serve_*``, tools/perf_gate.py --serve) — rendered
+    under Serving, NOT in the train-step table (an inference program
+    has no bwd/comms/optimizer)."""
+    preds = sorted(glob.glob(os.path.join(
+        artifacts_dir, "perf_pred_serve_*.json")))
+    if not preds:
+        return []
+    lines = ["", "Predicted device latency per (bucket, batch) rung "
+                 "(`tools/perf_gate.py --serve`, smoke widths — "
+                 "ratios, not absolutes):", "",
+             "| key | predicted ms | per image ms |", "|---|---|---|"]
+    for path in preds:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            lines.append(
+                f"| {rec.get('key', os.path.basename(path))} "
+                f"| {rec.get('predicted_latency_ms', '-')} "
+                f"| {rec.get('predicted_latency_per_image_ms', '-')}"
+                " |")
+        except (json.JSONDecodeError, OSError) as e:
+            lines.append(f"| {os.path.basename(path)} "
+                         f"| unreadable: {e!r} | |")
+    return lines
+
+
 def _predicted_section(artifacts_dir: Optional[str]) -> List[str]:
     """Predicted-vs-measured step-time table from the perf-gate bank
     (ISSUE 7), degrading to a pointer exactly like the span-tracing
@@ -565,6 +680,11 @@ def _predicted_section(artifacts_dir: Optional[str]) -> List[str]:
                 __file__))), "artifacts")
     preds = sorted(glob.glob(os.path.join(artifacts_dir,
                                           "perf_pred_*.json")))
+    # serving predictions (perf_pred_serve_*) price the INFERENCE
+    # program — fwd/bwd/comms/optimizer rows would be meaningless in
+    # this TRAIN-step table; they render in the Serving section
+    preds = [p for p in preds if not os.path.basename(p)
+             .startswith("perf_pred_serve_")]
     if not preds:
         lines += ["", "No `perf_pred_*.json` prediction artifacts in "
                       f"`{artifacts_dir}` — run `python "
@@ -652,6 +772,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.extend(_attribution_section(logdir, attribution))
     lines.append("")
     lines.extend(_predicted_section(artifacts_dir))
+    lines.append("")
+    lines.extend(_serving_section(artifacts_dir))
     lines.append("")
     return "\n".join(lines)
 

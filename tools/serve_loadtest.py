@@ -1,0 +1,387 @@
+"""Closed+open-loop load generator for the online serving subsystem.
+
+Drives ``POST /v1/predict`` on a running server (``python -m
+eksml_tpu.serve``) with seeded synthetic images of mixed sizes and
+banks an ``artifacts/serve_r<N>.json`` latency/throughput artifact
+next to the training ladder — the serving half of the repo's
+banked-evidence rule (artifacts/README.md):
+
+- **closed loop** (default): ``--concurrency`` workers each issue
+  requests back-to-back until ``--requests`` complete — measures the
+  server's throughput ceiling and the latency AT that ceiling.
+- **open loop** (``--mode open --rate R``): requests fire on a fixed
+  arrival schedule regardless of completions — measures latency under
+  a *given* offered load, the way real user traffic behaves
+  (closed-loop latency hides queueing collapse; open-loop exposes it).
+
+Every record carries the server's span-derived ``timings_ms`` phase
+breakdown (queue_wait / pad / device_infer / postprocess), so the
+artifact attributes tail latency to a phase, and the post-run
+``/healthz`` scrape pins the engine's compile counters — the banked
+proof that the request path compiled NOTHING after warmup.
+
+Usage::
+
+    python tools/serve_loadtest.py --url http://127.0.0.1:8081 \\
+        --requests 200 --concurrency 8 --bank
+    python tools/serve_loadtest.py --port-file /tmp/serve.port \\
+        --mode open --rate 50 --requests 500 --out artifacts/serve_r2.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import glob
+import json
+import os
+import queue
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eksml_tpu.fsio import atomic_write_json  # noqa: E402
+
+PHASES = ("queue_wait", "pad", "device_infer", "postprocess")
+
+DEFAULT_SIZES = "480x640,640x480,330x500,600x400,512x512"
+
+
+def _utcnow() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def gen_image(seed: int, idx: int, sizes: List[Tuple[int, int]]
+              ) -> np.ndarray:
+    """Deterministic synthetic uint8 RGB image for request ``idx``."""
+    rng = np.random.RandomState(seed + idx)
+    h, w = sizes[idx % len(sizes)]
+    return rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+
+
+def post_predict(url: str, image: np.ndarray, timeout: float = 120.0,
+                 score_thresh: Optional[float] = None) -> Dict:
+    """One request; returns the decoded response with ``_latency_ms``
+    (client-observed) added.  Raises ``urllib.error.HTTPError`` on a
+    non-2xx answer."""
+    payload: Dict = {
+        "image_b64": base64.b64encode(image.tobytes()).decode("ascii"),
+        "shape": list(image.shape),
+        "dtype": "uint8",
+    }
+    if score_thresh is not None:
+        payload["score_thresh"] = score_thresh
+    body = json.dumps(payload).encode("utf-8")
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = json.loads(resp.read().decode("utf-8"))
+    out["_latency_ms"] = (time.perf_counter() - t0) * 1e3
+    return out
+
+
+def fetch_health(url: str, timeout: float = 10.0) -> Dict:
+    """``/healthz`` payload regardless of status code (503 while
+    warming/draining still carries the state fields)."""
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/healthz",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read().decode("utf-8"))
+
+
+def wait_ready(url: str, budget: float = 600.0) -> Dict:
+    """Poll ``/healthz`` until it reports ``ok`` (warmup done)."""
+    deadline = time.monotonic() + budget
+    last: Dict = {}
+    while time.monotonic() < deadline:
+        try:
+            last = fetch_health(url)
+            if last.get("status") == "ok":
+                return last
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.25)
+    raise TimeoutError(
+        f"server at {url} not ready within {budget}s "
+        f"(last /healthz: {last})")
+
+
+def metric_value(metrics_text: str, name: str,
+                 labels: str = "") -> Optional[float]:
+    """First sample value of ``name{labels}`` in an OpenMetrics body."""
+    pat = re.compile(r"^" + re.escape(name)
+                     + (re.escape(labels) if labels else r"(?:\{[^}]*\})?")
+                     + r" (\S+)$", re.M)
+    m = pat.search(metrics_text)
+    return float(m.group(1)) if m else None
+
+
+def scrape_metrics(url: str, timeout: float = 10.0) -> str:
+    with urllib.request.urlopen(url.rstrip("/") + "/metrics",
+                                timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _pct(values: List[float], q: float) -> float:
+    return float(np.percentile(np.asarray(values), q)) if values else 0.0
+
+
+def run_load(url: str, requests: int, concurrency: int,
+             mode: str = "closed", rate: float = 0.0, seed: int = 0,
+             sizes: str = DEFAULT_SIZES,
+             timeout: float = 120.0) -> Dict:
+    """Drive the load and fold the records into the artifact dict."""
+    size_list = [tuple(int(d) for d in s.split("x"))
+                 for s in sizes.split(",") if s]
+    records: List[Dict] = []
+    errors: List[str] = []
+    slips_ms: List[float] = []
+    rec_lock = threading.Lock()
+    work: "queue.Queue" = queue.Queue()
+    for i in range(requests):
+        work.put(i)
+    # open loop needs headroom beyond the closed-loop worker count:
+    # with only `concurrency` workers, arrivals silently throttle to
+    # the completion rate the moment latency exceeds the inter-arrival
+    # gap — coordinated omission, the exact bias open loop exists to
+    # avoid.  Workers auto-size (concurrency stays a floor) and any
+    # residual schedule slip is MEASURED and banked, never hidden.
+    n_workers = (max(1, concurrency) if mode != "open"
+                 else min(requests, max(concurrency, 64)))
+    t_start = time.perf_counter()
+
+    def one(idx: int) -> None:
+        if mode == "open" and rate > 0:
+            # fixed arrival schedule: request idx fires at idx/rate
+            # seconds after start, whatever the completions are doing
+            delay = t_start + idx / rate - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                with rec_lock:
+                    slips_ms.append(-delay * 1e3)
+        img = gen_image(seed, idx, size_list)
+        try:
+            resp = post_predict(url, img, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            with rec_lock:
+                errors.append(f"req {idx}: {e!r}")
+            return
+        with rec_lock:
+            records.append({
+                "idx": idx,
+                "total_ms": resp["_latency_ms"],
+                "phases": {k: resp.get("timings_ms", {}).get(k)
+                           for k in PHASES},
+                "bucket": resp.get("bucket"),
+                "batch_fill": resp.get("batch_fill"),
+                "batch_rung": resp.get("batch_rung"),
+                "detections": len(resp.get("detections", ())),
+            })
+
+    def worker() -> None:
+        while True:
+            try:
+                idx = work.get_nowait()
+            except queue.Empty:
+                return
+            one(idx)
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"loadgen-{i}")
+               for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t_start
+
+    lat = [r["total_ms"] for r in records]
+    phase_ms = {}
+    for ph in PHASES:
+        vals = [r["phases"][ph] for r in records
+                if isinstance(r["phases"].get(ph), (int, float))]
+        phase_ms[ph] = {"mean": round(float(np.mean(vals)), 3)
+                        if vals else None,
+                        "p99": round(_pct(vals, 99), 3)
+                        if vals else None}
+    fills = [r["batch_fill"] / r["batch_rung"] for r in records
+             if r.get("batch_rung")]
+    slowest = sorted(records, key=lambda r: -r["total_ms"])[:5]
+    for s in slowest:
+        ph = {k: v for k, v in s["phases"].items()
+              if isinstance(v, (int, float))}
+        s["dominant_phase"] = (max(ph, key=ph.get) if ph else None)
+    open_loop = None
+    if mode == "open":
+        behind = [s for s in slips_ms if s > 5.0]
+        open_loop = {
+            "workers": n_workers,
+            "arrivals_behind": len(behind),
+            "slip_ms": {
+                "mean": round(float(np.mean(slips_ms)), 3)
+                if slips_ms else 0.0,
+                "p99": round(_pct(slips_ms, 99), 3)
+                if slips_ms else 0.0,
+                "max": round(max(slips_ms), 3) if slips_ms else 0.0,
+            },
+            # nonzero arrivals_behind = the offered rate was NOT
+            # fully sustained (worker pool or client box saturated);
+            # the latency numbers then understate the true open-loop
+            # tail — read them as a lower bound
+            "offered_rate_sustained": not behind,
+        }
+    return {
+        "kind": "serve_loadtest",
+        "mode": mode,
+        "rate_rps": rate if mode == "open" else None,
+        "open_loop": open_loop,
+        "requests": requests,
+        "completed": len(records),
+        "errors": len(errors),
+        "error_samples": errors[:5],
+        "concurrency": concurrency,
+        "sizes": sizes,
+        "seed": seed,
+        "wall_s": round(wall_s, 3),
+        "images_per_sec": round(len(records) / wall_s, 3)
+        if wall_s > 0 else 0.0,
+        "latency_ms": {
+            "p50": round(_pct(lat, 50), 3),
+            "p90": round(_pct(lat, 90), 3),
+            "p99": round(_pct(lat, 99), 3),
+            "mean": round(float(np.mean(lat)), 3) if lat else 0.0,
+            "max": round(max(lat), 3) if lat else 0.0,
+        },
+        "phase_ms": phase_ms,
+        "batch_occupancy_mean": round(float(np.mean(fills)), 3)
+        if fills else None,
+        "slowest": slowest,
+    }
+
+
+def next_bank_path(artifacts_dir: str) -> str:
+    """First free ``serve_r<N>.json`` slot."""
+    taken = set()
+    for p in glob.glob(os.path.join(artifacts_dir, "serve_r*.json")):
+        m = re.match(r"serve_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            taken.add(int(m.group(1)))
+    n = 1
+    while n in taken:
+        n += 1
+    return os.path.join(artifacts_dir, f"serve_r{n}.json")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", default=None,
+                   help="server base URL, e.g. http://127.0.0.1:8081")
+    p.add_argument("--port-file", default=None,
+                   help="read the port from this file (the --port-file "
+                        "the server wrote) and target 127.0.0.1")
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--mode", choices=["closed", "open"],
+                   default="closed")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="open-loop arrival rate (requests/sec)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sizes", default=DEFAULT_SIZES,
+                   help="comma list of HxW request image sizes "
+                        "[%(default)s]")
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--wait-ready", type=float, default=600.0,
+                   help="seconds to wait for /healthz ok before load")
+    p.add_argument("--out", default=None,
+                   help="write the artifact here (atomic)")
+    p.add_argument("--bank", action="store_true",
+                   help="write to the next free "
+                        "artifacts/serve_r<N>.json slot")
+    p.add_argument("--note", default=None,
+                   help="free-text provenance recorded in the "
+                        "artifact (geometry, hardware, caveats)")
+    args = p.parse_args(argv)
+
+    if args.url:
+        url = args.url
+    elif args.port_file:
+        deadline = time.monotonic() + args.wait_ready
+        while not os.path.exists(args.port_file):
+            if time.monotonic() > deadline:
+                p.error(f"port file {args.port_file} never appeared")
+            time.sleep(0.2)
+        url = f"http://127.0.0.1:{open(args.port_file).read().strip()}"
+    else:
+        p.error("need --url or --port-file")
+    if args.mode == "open" and args.rate <= 0:
+        p.error("--mode open needs --rate > 0")
+
+    health = wait_ready(url, budget=args.wait_ready)
+    artifact = run_load(url, args.requests, args.concurrency,
+                        mode=args.mode, rate=args.rate, seed=args.seed,
+                        sizes=args.sizes, timeout=args.timeout)
+    # post-run engine state: the zero-cold-compile proof and the
+    # per-chip normalization ride the SAME scrape the HPA uses
+    try:
+        post = fetch_health(url)
+        metrics = scrape_metrics(url)
+    except (urllib.error.URLError, OSError) as e:
+        post, metrics = {"error": repr(e)}, ""
+    devices = int(post.get("devices") or health.get("devices") or 1)
+    artifact.update({
+        "url": url,
+        "devices": devices,
+        "images_per_sec_per_chip": round(
+            artifact["images_per_sec"] / max(devices, 1), 3),
+        "engine": {
+            "compiles": post.get("compiles"),
+            "request_path_compiles": post.get("request_path_compiles"),
+            "warm_executables": post.get("warm_executables"),
+            "buckets": post.get("buckets"),
+            "batch_rungs": post.get("batch_rungs"),
+        },
+        "zero_request_path_compiles":
+            post.get("request_path_compiles") == 0,
+        "metrics": {
+            "requests_ok": metric_value(
+                metrics, "eksml_serve_requests_total",
+                '{outcome="ok"}'),
+            "batches": metric_value(metrics,
+                                    "eksml_serve_batches_total"),
+            "aot_compiles": metric_value(
+                metrics, "eksml_serve_aot_compiles_total"),
+            "request_path_compiles": metric_value(
+                metrics, "eksml_serve_request_path_compiles_total"),
+        },
+        "banked_at": _utcnow(),
+    })
+    if args.note:
+        artifact["note"] = args.note
+    payload = json.dumps(artifact, indent=1)
+    print(payload)
+    out = args.out
+    if out is None and args.bank:
+        out = next_bank_path(os.path.join(REPO, "artifacts"))
+    if out:
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        atomic_write_json(out, artifact)
+        print(f"banked {out}", file=sys.stderr)
+    return 0 if artifact["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
