@@ -246,16 +246,23 @@ def main(argv=None):
                         "(TRAIN.PARAM_DTYPE); bfloat16 halves the "
                         "state HBM — the 1344/b8 memory plan")
     p.add_argument("--sharding", default="replicated",
-                   choices=["replicated", "fsdp"],
+                   choices=["replicated", "fsdp", "tensor", "2d"],
                    help="sharding plan for the measured train step "
                         "(eksml_tpu/parallel/sharding.py): fsdp "
                         "shards params+optimizer state over the fsdp "
-                        "mesh axis, gathered just-in-time in the "
-                        "step; per-device state bytes land in the "
-                        "result JSON either way")
+                        "mesh axis, tensor shards the FPN/head "
+                        "weights' output features over the model "
+                        "axis, 2d composes both — all gathered "
+                        "just-in-time in the step; per-device state "
+                        "bytes land in the result JSON either way")
     p.add_argument("--fsdp-axis", type=int, default=0,
-                   help="fsdp axis size for --sharding fsdp "
-                        "(0 = all devices of one slice)")
+                   help="fsdp axis size for --sharding fsdp/2d "
+                        "(0 = all devices of one slice; under 2d, "
+                        "the rest of the slice after --model-axis)")
+    p.add_argument("--model-axis", type=int, default=0,
+                   help="model axis size for --sharding tensor/2d "
+                        "(0 = all devices of one slice under tensor; "
+                        "2d needs it set explicitly)")
     p.add_argument("--prefetch", type=int, default=-1,
                    choices=(-1, 0, 1),
                    help="input-pipeline A/B: -1 = one device-resident "
@@ -600,6 +607,7 @@ def run(args, diag: dict) -> None:
     cfg.TRAIN.SHARDING.STRATEGY = getattr(args, "sharding",
                                           "replicated")
     cfg.TRAIN.SHARDING.FSDP_AXIS_SIZE = getattr(args, "fsdp_axis", 0)
+    cfg.TRAIN.SHARDING.MODEL_AXIS_SIZE = getattr(args, "model_axis", 0)
     cfg.PREPROC.MAX_SIZE = size
     cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (size, size)
     cfg.update_args(args.config)
@@ -611,7 +619,7 @@ def run(args, diag: dict) -> None:
     sharding = str(cfg.TRAIN.SHARDING.STRATEGY)
     if sharding != "replicated":
         if getattr(args, "forward_only", False):
-            raise ValueError("sharding=fsdp measures the full "
+            raise ValueError(f"sharding={sharding} measures the full "
                              "train step (params+optimizer shards); "
                              "drop --forward-only")
         if getattr(args, "prefetch", -1) >= 0:
@@ -717,7 +725,8 @@ def run(args, diag: dict) -> None:
         # per-cycle latency matters most (code review r5)
         tx, _ = make_optimizer(cfg)
         if plan is not None:
-            opt_state, opt_sh = plan.init_sharded(tx.init, params)
+            opt_state, opt_sh = plan.init_sharded(tx.init, params,
+                                                  deterministic=True)
         else:
             opt_state = tx.init(params)
         # the per-device state cost of the active plan — what the

@@ -244,19 +244,30 @@ TELEMETRY_DEFAULTS = dict(
 #   shard both over the fsdp mesh axis (ZeRO-style), gathered
 #   just-in-time inside the step via sharding constraints — the
 #   memory plan for R101/cascade at batch/image sizes the replicated
-#   layout can't fit.  "tensor" = model-axis rules only (skeleton;
-#   execution lands later, the plan refuses to compile).
+#   layout can't fit.  "tensor" = shard the big FPN/head weights'
+#   output features over the model mesh axis (the rest replicated),
+#   gathered/scattered by the same constraint pair on the model
+#   axis.  "2d" = the fsdp x tensor composition: the tensor targets
+#   place (fsdp, model) jointly and everything else falls through to
+#   fsdp — per-device state tracks the axis PRODUCT.
 # - FSDP_AXIS_SIZE: devices on the fsdp axis (0 = every device of one
-#   slice).  Must divide the per-slice device count — param
-#   all-gathers are per-step traffic and must stay on ICI, never DCN.
+#   slice; under "2d", the rest of the slice after the model axis).
+#   Must divide the per-slice device count — param all-gathers are
+#   per-step traffic and must stay on ICI, never DCN.
+# - MODEL_AXIS_SIZE: devices on the model axis for "tensor"/"2d"
+#   (0 = every device of one slice under "tensor"; "2d" needs it set
+#   explicitly).  Same ICI-only divisibility contract; under "2d" the
+#   fsdp x model product must divide the per-slice device count.
 # - RULES: ordered ((regex, action), ...) partition rules matched
 #   against /-joined param-tree paths; action is "fsdp" (auto-place
-#   the axis on the largest divisible dim), "replicated", or a
+#   the axis on the largest divisible dim), "tensor" (model axis on
+#   the output-feature/last dim), "2d" (both), "replicated", or a
 #   literal PartitionSpec tuple.  MUST end with a catch-all.  () =
 #   the strategy's defaults (sharding.DEFAULT_RULES).
 SHARDING_DEFAULTS = dict(
     STRATEGY="replicated",
     FSDP_AXIS_SIZE=0,
+    MODEL_AXIS_SIZE=0,
     RULES=(),
 )
 
@@ -629,6 +640,8 @@ def finalize_configs(is_training: bool) -> AttrDict:
         _C.TRAIN.SHARDING.STRATEGY)
     assert int(_C.TRAIN.SHARDING.FSDP_AXIS_SIZE) >= 0, (
         _C.TRAIN.SHARDING.FSDP_AXIS_SIZE)
+    assert int(getattr(_C.TRAIN.SHARDING, "MODEL_AXIS_SIZE", 0)) >= 0, (
+        _C.TRAIN.SHARDING.MODEL_AXIS_SIZE)
     assert len(_C.FPN.ANCHOR_STRIDES) == len(_C.RPN.ANCHOR_SIZES)
     assert _C.PREPROC.MAX_SIZE % max(_C.FPN.ANCHOR_STRIDES) == 0, (
         "padded image size must be divisible by the coarsest FPN stride")

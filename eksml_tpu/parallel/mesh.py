@@ -11,8 +11,9 @@ v5e slices, and :func:`build_mesh` produces the
 ``jax.sharding.Mesh`` all training code shards over.
 
 Data parallelism is the parity strategy (SURVEY.md §2c); the mesh
-always carries a ``model`` axis (size 1 by default) so tensor/other
-axes are addable without re-plumbing.
+always carries a ``model`` axis (size 1 by default), and the
+``tensor``/``2d`` sharding plans (parallel/sharding.py) size it >1
+to shard the FPN/head weights' output features across chips.
 """
 
 from __future__ import annotations
@@ -69,6 +70,14 @@ V6E_TOPOLOGY_GRIDS = {name.replace("v5e-", "v6e-"): grid
 # topology_label, the chart enum and the C++ shim all track THIS
 TOPOLOGIES = {**V5E_TOPOLOGIES, **V6E_TOPOLOGIES}
 TOPOLOGY_GRIDS = {**V5E_TOPOLOGY_GRIDS, **V6E_TOPOLOGY_GRIDS}
+
+
+def divisors(n: int) -> list:
+    """Valid axis sizes for ``n`` devices — the payload of every
+    "axis size does not divide" error (ONE definition for build_mesh
+    and sharding.plan_mesh, so the suggested sizes can never drift
+    from the check that rejects them)."""
+    return [d for d in range(1, n + 1) if n % d == 0]
 
 
 def topology_label(topology: str) -> str:
@@ -232,6 +241,25 @@ def build_mesh(mesh_shape: Sequence[int] = (),
                 f"{num_slices} slices; the trailing axes "
                 f"{tuple(axis_names[1:])} (sizes {mesh_shape[1:]}) "
                 "must divide each slice's device count")
+    if need > n and "model" in axis_names:
+        # the model-axis analogue of the fsdp divisibility error
+        # below: when an OVERSIZE mesh's model axis is the size that
+        # cannot divide the per-slice device count, name that knob
+        # and spell out the valid sizes instead of the generic
+        # product message.  Gated on need > n deliberately — a
+        # covering mesh's model size always divides the product, and
+        # a SUBSET mesh (need < n, the single-chip smoke path) is
+        # legal whatever its model width, so only the oversize path
+        # ever implicates the model knob
+        m = mesh_shape[axis_names.index("model")]
+        per_slice = (n // num_slices
+                     if num_slices > 1 and n % num_slices == 0 else n)
+        if m > 1 and per_slice % m:
+            raise ValueError(
+                f"model axis size {m} does not divide the per-slice "
+                f"device count ({per_slice}) — "
+                f"TRAIN.SHARDING.MODEL_AXIS_SIZE must be one of "
+                f"{divisors(per_slice)}")
     if need > n:
         raise ValueError(
             f"mesh shape {tuple(mesh_shape)} over axes {axis_names} "

@@ -36,15 +36,29 @@ layout a *config knob* instead of a code path:
     reduce-scatter pair), and the optimizer update runs on shards.
     Per-device *persistent* state drops by ~the axis size; transient
     gather buffers are scheduled by XLA near their use.
-  * ``tensor`` — rules only (the ``model`` axis > 1 skeleton);
-    ``jit`` refuses with a clear NotImplementedError until execution
-    lands.
+  * ``tensor`` — the big FPN/head weights (lateral + output convs,
+    RPN conv0, box-head fc6/fc7, mask fcn/deconv) store their OUTPUT
+    features sharded over the ``model`` mesh axis; everything else
+    stays replicated.  Inside the step the same constraint pair fsdp
+    uses applies on the model axis: ``compute_params`` is the
+    matching input-side constraint (XLA lowers it to all-gathers of
+    the weight shards next to their matmuls) and ``storage_grads``
+    scatters the gradients back (reduce-scatter on ``model``) so the
+    optimizer updates shards.  Compute is replication-equivalent, so
+    loss streams stay at parity with ``replicated``.
+  * ``2d`` — the fsdp × tensor composition: the tensor-target
+    weights place ``("fsdp", "model")`` jointly (model on the output
+    features, fsdp on the largest remaining divisible dim) and every
+    other leaf falls through to fsdp auto-placement.  Per-device
+    state tracks the **axis product** — the memory plan that unlocks
+    R101/cascade backbones at 1344px.
 
 ``plan_mesh`` turns the ``TRAIN.SHARDING.*`` knobs into a
 ``(mesh_shape, axis_names)`` pair for :func:`build_mesh`, inserting
 the ``fsdp`` axis between ``data`` and ``model`` and validating the
-axis size against the per-slice device count — the fsdp all-gathers
-are per-step traffic and must ride ICI, never a DCN hop.
+axis sizes (and for ``2d`` their product) against the per-slice
+device count — the fsdp/model all-gathers are per-step traffic and
+must ride ICI, never a DCN hop.
 """
 
 from __future__ import annotations
@@ -57,28 +71,50 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# ONE divisor-list definition with build_mesh's model-axis error
+# (mesh.py imports nothing from this module — no cycle)
+from eksml_tpu.parallel.mesh import divisors as _divisors
+
 log = logging.getLogger(__name__)
 
-STRATEGIES = ("replicated", "fsdp", "tensor")
+STRATEGIES = ("replicated", "fsdp", "tensor", "2d")
 
 #: rule actions (besides a literal PartitionSpec tuple)
 REPLICATED = "replicated"
 FSDP_AUTO = "fsdp"
+TENSOR_AUTO = "tensor"   # model axis on the output-feature (last) dim
+TWOD_AUTO = "2d"         # model on output features + fsdp elsewhere
+
+#: the tensor-parallel weight targets: FPN lateral/output convs, the
+#: shared RPN conv, the box-head fc6/fc7 matmuls (plain and cascade),
+#: and the mask-head fcn/deconv stack.  Flax Conv/Dense kernels keep
+#: output features LAST, which is the dim the auto actions shard;
+#: tiny per-class output layers (rpn class/box, fastrcnn class/box,
+#: the mask logit conv) stay replicated — their widths are class
+#: counts, not hidden dims, and rarely divide a model axis.
+TENSOR_TARGETS = (
+    r"(fpn/(lateral|posthoc)_\d+"
+    r"|rpn/conv0"
+    r"|(fastrcnn|cascade\d*)/(fc6|fc7)"
+    r"|maskrcnn/(fcn\d+|deconv))/kernel$")
 
 # Strategy-default rule sets (TRAIN.SHARDING.RULES=() selects these).
 # fsdp shards EVERY leaf with a divisible dim — biases and norm scales
 # included, exactly like ZeRO — because the catch-all's auto placement
 # already degrades to replicated for the leaves that cannot split.
+# tensor shards only the TENSOR_TARGETS output features on the model
+# axis; 2d composes both — targets place (fsdp, model) jointly and
+# every other leaf falls through to fsdp auto-placement.
 DEFAULT_RULES: Dict[str, Tuple[Tuple[str, Any], ...]] = {
     "replicated": ((r".*", REPLICATED),),
     "fsdp": ((r".*", FSDP_AUTO),),
-    # tensor skeleton: shard the big head/FPN matmuls' output features
-    # over the model axis, replicate the rest.  Rules are real and
-    # testable; execution (activation specs, collective placement)
-    # lands in a later PR — ShardingPlan.jit refuses until then.
     "tensor": (
-        (r"(fc6|fc7|fc_head|frcnn_fc)\w*/kernel$", (None, "model")),
+        (TENSOR_TARGETS, TENSOR_AUTO),
         (r".*", REPLICATED),
+    ),
+    "2d": (
+        (TENSOR_TARGETS, TWOD_AUTO),
+        (r".*", FSDP_AUTO),
     ),
 }
 
@@ -107,9 +143,10 @@ def validate_rules(rules) -> Tuple[Tuple[str, Any], ...]:
     """Normalize + validate an ordered rule list.
 
     Each rule is ``(pattern, action)`` with action one of
-    ``"replicated"``, ``"fsdp"``, or a tuple of PartitionSpec entries
-    (``None`` / axis name / tuple of axis names).  The last rule must
-    be a catch-all — every leaf must be *claimed*, never defaulted.
+    ``"replicated"``, ``"fsdp"``, ``"tensor"``, ``"2d"``, or a tuple
+    of PartitionSpec entries (``None`` / axis name / tuple of axis
+    names).  The last rule must be a catch-all — every leaf must be
+    *claimed*, never defaulted.
     """
     try:
         rules = tuple(
@@ -131,10 +168,12 @@ def validate_rules(rules) -> Tuple[Tuple[str, Any], ...]:
                 f"partition rule pattern {pat!r} is not a valid "
                 f"regex: {e}") from e
         if isinstance(action, str):
-            if action not in (REPLICATED, FSDP_AUTO):
+            if action not in (REPLICATED, FSDP_AUTO, TENSOR_AUTO,
+                              TWOD_AUTO):
                 raise ValueError(
                     f"partition rule {pat!r}: string action must be "
-                    f"'replicated' or 'fsdp', got {action!r}")
+                    f"'replicated', 'fsdp', 'tensor' or '2d', got "
+                    f"{action!r}")
         else:
             for entry in action:
                 ok = entry is None or isinstance(entry, str) or (
@@ -154,23 +193,33 @@ def validate_rules(rules) -> Tuple[Tuple[str, Any], ...]:
     return rules
 
 
-def _auto_fsdp_spec(shape: Tuple[int, ...], axis_size: int,
-                    axis_name: str) -> Optional[P]:
-    """Place ``axis_name`` on the largest dim divisible by
-    ``axis_size``; None when no dim divides (caller replicates)."""
-    order = sorted(range(len(shape)), key=lambda i: (-shape[i], i))
+def _auto_axis_dim(shape: Tuple[int, ...], axis_size: int,
+                   exclude: Tuple[int, ...] = ()) -> Optional[int]:
+    """Index of the largest dim divisible by ``axis_size`` (ties →
+    lowest index), skipping ``exclude``; None when nothing divides
+    (caller replicates that axis)."""
+    order = sorted((i for i in range(len(shape)) if i not in exclude),
+                   key=lambda i: (-shape[i], i))
     for i in order:
         if shape[i] >= axis_size and shape[i] % axis_size == 0:
-            # trailing Nones dropped: P('fsdp') == the canonical form
-            return P(*([None] * i), axis_name)
+            return i
     return None
 
 
+def _spec_from_entries(entries: List[Optional[str]]) -> P:
+    # trailing Nones dropped: P('fsdp') == the canonical form
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
 def _match_leaf(path: str, leaf, rules, mesh_axes: Dict[str, int],
-                axis_size: int, fsdp_axis: str) -> Tuple[P, str]:
+                fsdp_axis: str, model_axis: str) -> Tuple[P, str]:
     """→ (PartitionSpec, why) for one leaf.  ``why`` names the rule
     (or guard) that claimed it — the explain() payload."""
     shape = tuple(getattr(leaf, "shape", ()))
+    fsdp_size = int(mesh_axes.get(fsdp_axis, 1))
+    model_size = int(mesh_axes.get(model_axis, 1))
     if len(shape) == 0 or int(np.prod(shape)) == 1:
         return P(), "(scalar)"
     for pat, action in rules:
@@ -179,11 +228,36 @@ def _match_leaf(path: str, leaf, rules, mesh_axes: Dict[str, int],
         if action == REPLICATED:
             return P(), pat
         if action == FSDP_AUTO:
-            spec = _auto_fsdp_spec(shape, axis_size, fsdp_axis)
-            if spec is None:
+            dim = _auto_axis_dim(shape, fsdp_size)
+            if dim is None:
                 return P(), f"{pat} (no dim divisible by " \
-                            f"{fsdp_axis}={axis_size}; replicated)"
-            return spec, pat
+                            f"{fsdp_axis}={fsdp_size}; replicated)"
+            entries: List[Optional[str]] = [None] * len(shape)
+            entries[dim] = fsdp_axis
+            return _spec_from_entries(entries), pat
+        if action in (TENSOR_AUTO, TWOD_AUTO):
+            # output features are LAST in flax Conv/Dense kernels —
+            # that is the dim the model axis shards (column-parallel
+            # weight storage); the matching input-side constraint
+            # (compute_params) makes XLA gather the shards next to
+            # their matmuls and scatter the grads back
+            entries = [None] * len(shape)
+            last = len(shape) - 1
+            if shape[last] >= model_size and shape[last] % model_size == 0:
+                entries[last] = model_axis
+            if action == TWOD_AUTO:
+                dim = _auto_axis_dim(
+                    shape, fsdp_size,
+                    exclude=(last,) if entries[last] else ())
+                if dim is not None:
+                    entries[dim] = fsdp_axis
+            if all(e is None for e in entries):
+                return P(), (f"{pat} (no dim divisible by "
+                             f"{model_axis}={model_size}"
+                             + (f"/{fsdp_axis}={fsdp_size}"
+                                if action == TWOD_AUTO else "")
+                             + "; replicated)")
+            return _spec_from_entries(entries), pat
         # literal PartitionSpec tuple
         if len(action) > len(shape):
             raise ValueError(
@@ -214,7 +288,8 @@ def _match_leaf(path: str, leaf, rules, mesh_axes: Dict[str, int],
 
 
 def match_partition_rules(rules, tree, mesh: Mesh,
-                          fsdp_axis: str = "fsdp"):
+                          fsdp_axis: str = "fsdp",
+                          model_axis: str = "model"):
     """Pytree of PartitionSpec from ordered rules (first match wins).
 
     Accepts arrays or ShapeDtypeStructs.  Raises on an unclaimed leaf;
@@ -222,11 +297,10 @@ def match_partition_rules(rules, tree, mesh: Mesh,
     friendlier catch-all error.
     """
     mesh_axes = dict(mesh.shape)
-    axis_size = int(mesh_axes.get(fsdp_axis, 1))
 
     def one(path, leaf):
         spec, _ = _match_leaf(tree_path_str(path), leaf, rules,
-                              mesh_axes, axis_size, fsdp_axis)
+                              mesh_axes, fsdp_axis, model_axis)
         return spec
 
     return jax.tree_util.tree_map_with_path(one, tree)
@@ -284,8 +358,6 @@ def sharding_knobs(cfg) -> Dict[str, Any]:
         SHARDING_DEFAULTS)
 
 
-def _divisors(n: int) -> List[int]:
-    return [d for d in range(1, n + 1) if n % d == 0]
 
 
 def plan_mesh(cfg, n_devices: Optional[int] = None
@@ -293,14 +365,18 @@ def plan_mesh(cfg, n_devices: Optional[int] = None
     """``TRAIN.SHARDING.*`` + ``TPU.MESH_*`` → (mesh_shape, axes) for
     :func:`build_mesh`.
 
-    ``replicated``/``tensor`` keep the legacy mesh untouched (tensor
-    execution lands later; its model axis stays 1 until then).  For
-    ``fsdp`` the axis is inserted between ``data`` and the rest, sized
-    by ``FSDP_AXIS_SIZE`` (0 = every device of one slice), and
-    validated against the per-slice device count — parameter
-    all-gathers are per-step traffic and must stay on ICI, so a shard
-    group may never straddle a DCN hop.  An explicit operator
-    ``TPU.MESH_SHAPE`` always wins (but must name the fsdp axis).
+    ``replicated`` keeps the legacy mesh untouched.  ``fsdp`` inserts
+    the fsdp axis between ``data`` and the rest, sized by
+    ``FSDP_AXIS_SIZE`` (0 = every device of one slice).  ``tensor``
+    sizes the existing ``model`` axis from ``MODEL_AXIS_SIZE`` (0 =
+    every device of one slice).  ``2d`` composes both: the model axis
+    must be set explicitly (>0) and ``FSDP_AXIS_SIZE=0`` resolves to
+    the rest of the slice.  Every shard axis — and for ``2d`` the
+    fsdp × model product — must divide the per-slice device count:
+    parameter all-gathers are per-step traffic and must stay on ICI,
+    so a shard group may never straddle a DCN hop.  An explicit
+    operator ``TPU.MESH_SHAPE`` always wins (but must name the axes
+    the strategy shards over).
     """
     knobs = sharding_knobs(cfg)
     strategy = str(knobs["STRATEGY"])
@@ -310,17 +386,28 @@ def plan_mesh(cfg, n_devices: Optional[int] = None
             f"{STRATEGIES}")
     shape = tuple(int(s) for s in cfg.TPU.MESH_SHAPE)
     axes = tuple(cfg.TPU.MESH_AXES)
-    if strategy != "fsdp":
+    if strategy == "replicated":
         return shape, axes
-    if "fsdp" not in axes:
+    needs_fsdp = strategy in ("fsdp", "2d")
+    needs_model = strategy in ("tensor", "2d")
+    if needs_fsdp and "fsdp" not in axes:
         if shape:
             raise ValueError(
-                f"TRAIN.SHARDING.STRATEGY=fsdp needs an 'fsdp' mesh "
-                f"axis, but the explicit TPU.MESH_SHAPE={shape} / "
-                f"TPU.MESH_AXES={axes} does not name one — add it "
+                f"TRAIN.SHARDING.STRATEGY={strategy} needs an 'fsdp' "
+                f"mesh axis, but the explicit TPU.MESH_SHAPE={shape} /"
+                f" TPU.MESH_AXES={axes} does not name one — add it "
                 "(e.g. MESH_AXES=('data','fsdp','model')) or clear "
                 "MESH_SHAPE to derive the mesh from the knobs")
         axes = axes[:1] + ("fsdp",) + axes[1:]
+    if needs_model and "model" not in axes:
+        if shape:
+            raise ValueError(
+                f"TRAIN.SHARDING.STRATEGY={strategy} needs a 'model' "
+                f"mesh axis, but the explicit TPU.MESH_SHAPE={shape} /"
+                f" TPU.MESH_AXES={axes} does not name one — add it "
+                "(e.g. MESH_AXES=('data','fsdp','model')) or clear "
+                "MESH_SHAPE to derive the mesh from the knobs")
+        axes = axes + ("model",)
     if shape:
         return shape, axes
     n = n_devices if n_devices else len(jax.devices())
@@ -330,18 +417,43 @@ def plan_mesh(cfg, n_devices: Optional[int] = None
             f"{n} device(s) do not split into TPU.NUM_SLICES="
             f"{num_slices}")
     per_slice = n // num_slices
-    f = int(knobs["FSDP_AXIS_SIZE"]) or per_slice
-    if f < 1 or per_slice % f:
+    m = 1
+    if needs_model:
+        m = int(knobs["MODEL_AXIS_SIZE"])
+        if m == 0 and strategy == "tensor":
+            m = per_slice  # the fsdp-knob semantics, on the model axis
+        if m < 1 or per_slice % m:
+            raise ValueError(
+                f"TRAIN.SHARDING.MODEL_AXIS_SIZE={m} is invalid for "
+                f"{n} device(s) in {num_slices} slice(s) ({per_slice} "
+                f"per slice): the model axis must divide the per-slice"
+                f" device count so weight shards never straddle a DCN "
+                f"hop (and the 2d strategy needs it set explicitly, "
+                f"> 0); valid sizes here: {_divisors(per_slice)}")
+    f = 1
+    if needs_fsdp:
+        f = int(knobs["FSDP_AXIS_SIZE"]) or per_slice // m
+        if f < 1 or per_slice % f:
+            raise ValueError(
+                f"TRAIN.SHARDING.FSDP_AXIS_SIZE={f} is invalid for {n} "
+                f"device(s) in {num_slices} slice(s) ({per_slice} per "
+                f"slice): the fsdp axis must divide the per-slice device "
+                f"count so parameter shards never straddle a DCN hop; "
+                f"valid sizes here: {_divisors(per_slice)}")
+    if per_slice % (f * m):
         raise ValueError(
-            f"TRAIN.SHARDING.FSDP_AXIS_SIZE={f} is invalid for {n} "
-            f"device(s) in {num_slices} slice(s) ({per_slice} per "
-            f"slice): the fsdp axis must divide the per-slice device "
-            f"count so parameter shards never straddle a DCN hop; "
-            f"valid sizes here: {_divisors(per_slice)}")
+            f"TRAIN.SHARDING.FSDP_AXIS_SIZE={f} x "
+            f"TRAIN.SHARDING.MODEL_AXIS_SIZE={m} = {f * m} does not "
+            f"divide the per-slice device count ({per_slice}): a 2d "
+            f"shard group must fit inside one slice so its collectives "
+            f"never straddle a DCN hop; the axis product must be one "
+            f"of {_divisors(per_slice)}")
     # size axes BY NAME: an operator MESH_AXES ordering the fsdp axis
     # anywhere but index 1 must still get its size (positional sizing
     # silently left fsdp at 1 — a fully-replicated run claiming fsdp)
-    return tuple(n // f if a == "data" else f if a == "fsdp" else 1
+    return tuple(n // (f * m) if a == "data"
+                 else f if a == "fsdp"
+                 else m if a == "model" else 1
                  for a in axes), axes
 
 
@@ -353,7 +465,7 @@ class ShardingPlan:
     """
 
     def __init__(self, strategy: str, mesh: Mesh, rules=(),
-                 fsdp_axis: str = "fsdp"):
+                 fsdp_axis: str = "fsdp", model_axis: str = "model"):
         if strategy not in STRATEGIES:
             raise ValueError(
                 f"unknown sharding strategy {strategy!r}; valid: "
@@ -361,18 +473,30 @@ class ShardingPlan:
         self.strategy = strategy
         self.mesh = mesh
         self.fsdp_axis = fsdp_axis
+        self.model_axis = model_axis
         mesh_axes = dict(mesh.shape)
-        if strategy == "fsdp" and fsdp_axis not in mesh_axes:
+        if strategy in ("fsdp", "2d") and fsdp_axis not in mesh_axes:
             raise ValueError(
-                f"sharding strategy 'fsdp' needs a {fsdp_axis!r} mesh "
-                f"axis; this mesh has {tuple(mesh.axis_names)} — "
-                "build it via plan_mesh(cfg) (train.py does)")
+                f"sharding strategy {strategy!r} needs a "
+                f"{fsdp_axis!r} mesh axis; this mesh has "
+                f"{tuple(mesh.axis_names)} — build it via "
+                "plan_mesh(cfg) (train.py does)")
+        if strategy in ("tensor", "2d") and model_axis not in mesh_axes:
+            raise ValueError(
+                f"sharding strategy {strategy!r} needs a "
+                f"{model_axis!r} mesh axis; this mesh has "
+                f"{tuple(mesh.axis_names)} — build it via "
+                "plan_mesh(cfg) (train.py does)")
         self.axis_size = int(mesh_axes.get(fsdp_axis, 1))
+        self.model_axis_size = int(mesh_axes.get(model_axis, 1))
         self.rules = validate_rules(rules or DEFAULT_RULES[strategy])
-        batch_axes = tuple(a for a in ("data", fsdp_axis)
+        batch_axes = tuple(a for a in ("data", fsdp_axis, model_axis)
                            if a in mesh_axes)
-        #: batch rows split over data (and, when present, fsdp — the
-        #: two together are "all the replicas"); the spec
+        #: batch rows split over EVERY mesh axis — each chip carries
+        #: its own rows under every strategy (the strategies change
+        #: the STORAGE layout, never the replica count), which is
+        #: what keeps per-image compute — and therefore the loss
+        #: stream — bit-identical to replicated; the spec
         #: _globalize_batch and bench both use
         self.batch_spec = (P(batch_axes[0]) if len(batch_axes) == 1
                            else P(batch_axes))
@@ -398,39 +522,69 @@ class ShardingPlan:
         if self.strategy == "replicated":
             return jax.tree.map(lambda _: P(), tree)
         return match_partition_rules(self.rules, tree, self.mesh,
-                                     fsdp_axis=self.fsdp_axis)
+                                     fsdp_axis=self.fsdp_axis,
+                                     model_axis=self.model_axis)
 
     def shardings(self, tree):
         """NamedSharding pytree (what jit/device_put consume)."""
         return jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self.specs(tree))
 
-    def init_sharded(self, fn, *args):
+    def init_sharded(self, fn, *args, deterministic: bool = False):
         """Run ``fn(*args)`` jitted with the plan's shardings over its
         abstract output → ``(value, shardings)``.  State is BORN in
-        its storage layout — no device ever holds a replicated copy it
-        would immediately shard.  ONE definition of the
-        eval_shape→shardings→out_shardings idiom for trainer, bench
-        and dryrun (three hand-rolled copies could drift and measure
-        different layouts under the same plan name)."""
+        its storage layout — no device ever holds a replicated copy
+        it would immediately shard (the PR 6 idiom, parity-pinned).
+
+        One exception: an RNG-bearing ``fn`` (the model init) under a
+        model-axis plan (``tensor``/``2d``).  The repo's pinned RNG
+        mode is non-partitionable threefry, and partitioning the init
+        program over a mesh with a model axis > 1 changes the
+        generated bits themselves (the partitioner re-lowers the RNG
+        ops — reproduced as different weights on 15 leaves of the R50
+        tree, which would break the tensor-vs-replicated loss pin at
+        the first step).  Those init with fully REPLICATED
+        out-shardings instead — zero partitioning freedom ⇒ canonical
+        values by construction — then MOVE the shards onto the
+        storage layout (device_put preserves values); the transient
+        replicated copy exists only during init.  Pass
+        ``deterministic=True`` for RNG-free builders (``tx.init`` —
+        zeros shaped like the params) to keep even the model-axis
+        plans born sharded: there are no random bits to perturb, and
+        a replicated momentum tree at init is exactly the HBM the 2d
+        memory plan exists to shed.
+
+        ONE definition of the eval_shape→shardings→out_shardings
+        idiom for trainer, bench and dryrun (three hand-rolled copies
+        could drift and measure different layouts under the same plan
+        name)."""
         sh = self.shardings(jax.eval_shape(fn, *args))
+        if self.model_axis_size > 1 and not deterministic:
+            repl = self.replicated()
+            out = jax.jit(fn, out_shardings=jax.tree.map(
+                lambda _: repl, sh))(*args)
+            return jax.device_put(out, sh), sh
         return jax.jit(fn, out_shardings=sh)(*args), sh
 
     # -- inside-the-step constraints ----------------------------------
 
     def compute_params(self, params):
-        """FSDP: gather the param shards just-in-time for compute (a
-        replication constraint XLA lowers to all-gathers near use).
-        Identity under ``replicated`` — the program is unchanged."""
+        """Gather the param shards just-in-time for compute — the
+        matching input-side constraint of the storage sharding (a
+        replication constraint XLA lowers to all-gathers near use:
+        on the fsdp axis under ``fsdp``, the model axis under
+        ``tensor``, both under ``2d``).  Identity under
+        ``replicated`` — the program is unchanged."""
         if self.strategy == "replicated":
             return params
         return jax.lax.with_sharding_constraint(params,
                                                 self.replicated())
 
     def storage_grads(self, grads):
-        """FSDP: constrain gradients back to the storage layout (XLA
-        lowers the psum+slice to a reduce-scatter), so the optimizer
-        update runs on shards.  Identity under ``replicated``."""
+        """Constrain gradients back to the storage layout (XLA
+        lowers the psum+slice to reduce-scatters on the storage
+        axes), so the optimizer update runs on shards.  Identity
+        under ``replicated``."""
         if self.strategy == "replicated":
             return grads
         return jax.lax.with_sharding_constraint(grads,
@@ -440,12 +594,10 @@ class ShardingPlan:
 
     def jit(self, fn, **jit_kwargs):
         """``jax.jit`` behind the plan: the single place strategy
-        executability is enforced (SNIPPETS.md [3])."""
-        if self.strategy == "tensor":
-            raise NotImplementedError(
-                "sharding strategy 'tensor': partition rules are "
-                "defined (model axis specs) but step execution has "
-                "not landed yet — use 'replicated' or 'fsdp'")
+        executability would be enforced (SNIPPETS.md [3]).  Every
+        strategy in :data:`STRATEGIES` is executable since the
+        tensor/2d plans landed — the wrapper stays so a future
+        skeleton strategy has somewhere to refuse."""
         return jax.jit(fn, **jit_kwargs)
 
     # -- introspection ------------------------------------------------
@@ -462,8 +614,8 @@ class ShardingPlan:
                 spec, why = P(), "(strategy: replicated)"
             else:
                 spec, why = _match_leaf(p, leaf, self.rules,
-                                        mesh_axes, self.axis_size,
-                                        self.fsdp_axis)
+                                        mesh_axes, self.fsdp_axis,
+                                        self.model_axis)
             shape = tuple(getattr(leaf, "shape", ()))
             div = 1
             for entry in spec:
@@ -487,5 +639,12 @@ class ShardingPlan:
         """One-line summary for logs and bench diagnostics."""
         if self.strategy == "fsdp":
             return (f"fsdp(axis={self.axis_size}, "
+                    f"rules={len(self.rules)})")
+        if self.strategy == "tensor":
+            return (f"tensor(model={self.model_axis_size}, "
+                    f"rules={len(self.rules)})")
+        if self.strategy == "2d":
+            return (f"2d(fsdp={self.axis_size}, "
+                    f"model={self.model_axis_size}, "
                     f"rules={len(self.rules)})")
         return self.strategy

@@ -29,7 +29,8 @@ SCHEMA_VERSION = 1
 #: topologies incompatible for a byte-layout-trusting restore — the
 #: elastic path reshards, the non-elastic path fails fast.
 FIELDS = ("mesh_shape", "mesh_axes", "num_slices", "strategy",
-          "fsdp_axis_size", "num_devices", "process_count")
+          "fsdp_axis_size", "model_axis_size", "num_devices",
+          "process_count")
 
 
 def current_topology(mesh, plan, num_slices: int = 1) -> Dict[str, Any]:
@@ -37,9 +38,9 @@ def current_topology(mesh, plan, num_slices: int = 1) -> Dict[str, Any]:
 
     ``mesh`` is the live :class:`jax.sharding.Mesh`; ``plan`` the
     active :class:`~eksml_tpu.parallel.sharding.ShardingPlan` (its
-    ``axis_size`` is the RESOLVED fsdp width, not the raw knob — a
-    knob of 0 means "per-slice device count" and would alias distinct
-    layouts).
+    ``axis_size``/``model_axis_size`` are the RESOLVED widths, not
+    the raw knobs — a knob of 0 means "per-slice device count" and
+    would alias distinct layouts).
     """
     import jax
 
@@ -49,6 +50,7 @@ def current_topology(mesh, plan, num_slices: int = 1) -> Dict[str, Any]:
         "num_slices": int(num_slices),
         "strategy": str(plan.strategy),
         "fsdp_axis_size": int(plan.axis_size),
+        "model_axis_size": int(getattr(plan, "model_axis_size", 1)),
         "num_devices": int(mesh.devices.size),
         "process_count": int(jax.process_count()),
     }
@@ -93,6 +95,10 @@ def describe(topo: Any) -> str:
     strat = t["strategy"]
     if strat == "fsdp":
         strat = f"fsdp({t['fsdp_axis_size']})"
+    elif strat == "tensor":
+        strat = f"tensor({t['model_axis_size']})"
+    elif strat == "2d":
+        strat = f"2d({t['fsdp_axis_size']}x{t['model_axis_size']})"
     return (f"mesh {t['mesh_shape']} over {t['mesh_axes']}, {strat}, "
             f"{t['num_slices']} slice(s), {t['num_devices']} "
             f"device(s), {t['process_count']} proc(s)")

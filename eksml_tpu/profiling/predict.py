@@ -129,18 +129,23 @@ def _ring_factor(opcode: str, k: int) -> float:
 def comm_sizes_for_mesh(mesh_shape: Dict[str, int]) -> Dict[str, int]:
     """Sharding-plan mesh → per-collective participant counts.
 
-    all-gather / reduce-scatter are the fsdp param/grad layout moves
-    (they ride the ``fsdp`` axis); all-reduce is the gradient sum over
-    all replicas (``data × fsdp``).  The batch axes are the two that
-    carry replicas (sharding.py batch_spec)."""
+    all-gather / reduce-scatter are the param/grad layout moves: they
+    ride the STORAGE axes — ``fsdp`` under the fsdp plan, ``model``
+    under tensor, and their product under 2d (the plan's
+    compute_params/storage_grads constraint pair gathers and scatters
+    over every axis the leaf is stored on).  all-reduce is the
+    gradient sum over all replicas — ``data × fsdp × model``, since
+    batch rows ride every mesh axis (sharding.py batch_spec: the
+    strategies change the storage layout, never the replica count)."""
     fsdp = int(mesh_shape.get("fsdp", 1))
     data = int(mesh_shape.get("data", 1))
+    model = int(mesh_shape.get("model", 1))
     return {
-        "all-gather": fsdp,
-        "reduce-scatter": fsdp,
-        "all-reduce": data * fsdp,
+        "all-gather": fsdp * model,
+        "reduce-scatter": fsdp * model,
+        "all-reduce": data * fsdp * model,
         "collective-permute": 2,
-        "all-to-all": max(data * fsdp, 1),
+        "all-to-all": max(data * fsdp * model, 1),
     }
 
 
@@ -271,16 +276,20 @@ def predict_for_compiled(hlo_text: str,
 def lower_train_step(cfg, batch_size: int, image_size=None,
                      pad_hw: Optional[Tuple[int, int]] = None,
                      strategy: str = "replicated",
-                     fsdp_axis: int = 2
+                     fsdp_axis: int = 2,
+                     model_axis: int = 2
                      ) -> Tuple[str, Dict[str, Any]]:
     """AOT-lower + compile the real train step; → (hlo_text, meta).
 
     The same program construction bench.py measures: model from cfg,
     synthetic batch at the padded canvas, jitted init, optimizer, and
-    — under ``fsdp`` — the sharding plan's just-in-time gather /
-    storage-grad constraints over a ``(1, fsdp_axis, 1)`` mesh of
-    host-platform devices.  Only compiles; never executes a step, so
-    it runs on any backend (the gate runs it under
+    — under a sharded strategy — the sharding plan's just-in-time
+    gather / storage-grad constraints over a
+    ``(1, fsdp_axis, model_axis)`` mesh of host-platform devices
+    (``fsdp`` sizes only the fsdp axis, ``tensor`` only the model
+    axis, ``2d`` both — the model-axis collectives land in the HLO
+    and get priced).  Only compiles; never executes a step, so it
+    runs on any backend (the gate runs it under
     ``JAX_PLATFORMS=cpu``).
 
     ``meta`` carries the comm sizes for :func:`predict_from_hlo` plus
@@ -300,32 +309,44 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
     rng = jax.random.PRNGKey(0)
     tx, _ = make_optimizer(cfg)
 
+    from eksml_tpu.parallel.sharding import STRATEGIES
+
+    if strategy not in STRATEGIES:
+        # ONE strategy inventory (sharding.STRATEGIES) — a strategy
+        # added there must never read as unsupported here
+        raise ValueError(
+            f"lower_train_step supports {STRATEGIES}, got "
+            f"{strategy!r}")
     plan = None
     mesh_shape: Dict[str, int] = {}
-    if strategy == "fsdp":
+    if strategy != "replicated":
         from eksml_tpu.parallel import build_mesh
         from eksml_tpu.parallel.sharding import ShardingPlan
 
+        f = fsdp_axis if strategy in ("fsdp", "2d") else 1
+        m = model_axis if strategy in ("tensor", "2d") else 1
         devices = jax.devices()
-        if len(devices) < fsdp_axis:
+        if len(devices) < f * m:
             raise ValueError(
-                f"fsdp lowering needs {fsdp_axis} devices, have "
+                f"{strategy} lowering needs {f * m} devices, have "
                 f"{len(devices)} — set XLA_FLAGS=--xla_force_host_"
-                f"platform_device_count={fsdp_axis} before jax loads "
+                f"platform_device_count={f * m} before jax loads "
                 "(tools/perf_gate.py does)")
-        mesh = build_mesh((1, fsdp_axis, 1), ("data", "fsdp", "model"),
-                          devices[:fsdp_axis], num_slices=1)
-        plan = ShardingPlan("fsdp", mesh)
+        mesh = build_mesh((1, f, m), ("data", "fsdp", "model"),
+                          devices[:f * m], num_slices=1)
+        plan = ShardingPlan(strategy, mesh)
         mesh_shape = dict(mesh.shape)
-    elif strategy != "replicated":
-        raise ValueError(
-            f"lower_train_step supports 'replicated' and 'fsdp', got "
-            f"{strategy!r}")
 
     # per-chip batch semantics under a plan (the trainer/bench
-    # contract); the replicated path is the historical single-device
-    # program whose numbers the banked r5 artifacts measured
-    global_bs = batch_size * (fsdp_axis if plan is not None else 1)
+    # contract): batch rows ride EVERY mesh axis (sharding.py
+    # batch_spec — the strategies change the storage layout, never
+    # the replica count); the replicated path is the historical
+    # single-device program whose numbers the banked r5 artifacts
+    # measured
+    global_bs = batch_size * (
+        mesh_shape.get("data", 1) * mesh_shape.get("fsdp", 1)
+        * mesh_shape.get("model", 1)
+        if plan is not None else 1)
     batch = make_synthetic_batch(cfg, batch_size=global_bs,
                                  image_size=shape)
     batch = {k: jnp.asarray(v) for k, v in batch.items()
@@ -342,7 +363,8 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
     params = cast_params_for_storage(
         params, getattr(cfg.TRAIN, "PARAM_DTYPE", "float32"))
     if plan is not None:
-        opt_state, opt_sh = plan.init_sharded(tx.init, params)
+        opt_state, opt_sh = plan.init_sharded(tx.init, params,
+                                              deterministic=True)
     else:
         opt_state = tx.init(params)
 

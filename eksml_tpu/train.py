@@ -212,14 +212,22 @@ def make_synthetic_train_step(model, tx, plan=None, param_sh=None,
             return (optax.apply_updates(params, updates), new_opt,
                     losses["total_loss"])
 
+    # donate only on accelerators — the compiled_step rule: on
+    # XLA:CPU device buffers can alias external host memory (zero-copy
+    # device_put, jit outputs) and donating them is undefined behavior
+    # (the born-sharded 2d opt state turned bench's CPU smoke into a
+    # loss=nan + `buffer.IsAvailable()` abort).  Donation changes
+    # buffer aliasing, not the instruction stream, so the CPU-lowered
+    # priced program still matches the TPU-measured one.
+    donate = () if jax.default_backend() == "cpu" else (0, 1)
     if plan is not None:
         repl = plan.replicated()
         return plan.jit(train_step,
                         in_shardings=(param_sh, opt_sh,
                                       plan.batch_sharding(), repl),
                         out_shardings=(param_sh, opt_sh, repl),
-                        donate_argnums=(0, 1))
-    return jax.jit(train_step, donate_argnums=(0, 1))
+                        donate_argnums=donate)
+    return jax.jit(train_step, donate_argnums=donate)
 
 
 def _preregister_core_metrics(registry) -> None:
@@ -325,8 +333,9 @@ class Trainer:
                           chips_per_host=cfg.TRAIN.CHIPS_PER_HOST,
                           num_slices=cfg.TPU.NUM_SLICES)
         # the sharding plan decides the mesh axes: replicated keeps
-        # the legacy (data, model) layout untouched; fsdp inserts the
-        # fsdp axis and sizes it from TRAIN.SHARDING.FSDP_AXIS_SIZE
+        # the legacy (data, model) layout untouched; fsdp/2d insert
+        # the fsdp axis and tensor/2d size the model axis, from
+        # TRAIN.SHARDING.{FSDP,MODEL}_AXIS_SIZE
         # (parallel/sharding.py plan_mesh)
         mesh_shape, mesh_axes = plan_mesh(cfg)
         self.mesh = build_mesh(mesh_shape, mesh_axes,
@@ -420,8 +429,8 @@ class Trainer:
             params = self._load_backbone(params, param_sh)
         params = cast_params_for_storage(
             params, getattr(self.cfg.TRAIN, "PARAM_DTYPE", "float32"))
-        opt_state, opt_sh = self.plan.init_sharded(self.tx.init,
-                                                   params)
+        opt_state, opt_sh = self.plan.init_sharded(
+            self.tx.init, params, deterministic=True)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=opt_state, rng=rng)
         self._state_sharding = TrainState(
@@ -558,8 +567,8 @@ class Trainer:
             # real D2H copy, so it stays.
             donate = () if jax.default_backend() == "cpu" else (0,)
             # the PLAN supplies the in/out shardings (per-leaf trees
-            # under fsdp, the legacy replicated pair otherwise) and
-            # refuses un-executable strategies (tensor skeleton)
+            # under fsdp/tensor/2d, the legacy replicated pair
+            # otherwise)
             self._jit_step = self.plan.jit(
                 self._train_step,
                 in_shardings=(self._state_sharding, self._batch_sharding),

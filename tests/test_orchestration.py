@@ -264,13 +264,17 @@ def test_sharding_knobs_render_and_schema_matches_runtime(chart):
             "{{ .Values.maskrcnn.sharding_strategy }}") in tmpl
     assert ("TRAIN.SHARDING.FSDP_AXIS_SIZE="
             "{{ int .Values.maskrcnn.fsdp_axis_size }}") in tmpl
+    assert ("TRAIN.SHARDING.MODEL_AXIS_SIZE="
+            "{{ int .Values.maskrcnn.model_axis_size }}") in tmpl
     schema = json.loads(_read(f"{chart}/values.schema.json"))
     props = schema["properties"]["maskrcnn"]["properties"]
     assert tuple(props["sharding_strategy"]["enum"]) == STRATEGIES
     assert props["fsdp_axis_size"]["minimum"] == 0
+    assert props["model_axis_size"]["minimum"] == 0
     vals = yaml.safe_load(_read(f"{chart}/values.yaml"))["maskrcnn"]
     # shipped default stays the parity layout
     assert vals["sharding_strategy"] == "replicated"
+    assert vals["model_axis_size"] == 0
 
 
 @pytest.mark.parametrize("chart", ["charts/maskrcnn",

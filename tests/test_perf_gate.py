@@ -152,6 +152,17 @@ def test_comm_sizes_for_mesh():
     # no mesh → single device → every ring factor degenerates to 0
     empty = P.comm_sizes_for_mesh({})
     assert empty["all-reduce"] == 1 and empty["all-gather"] == 1
+    # model-axis collectives (ISSUE 15): the layout moves ride the
+    # STORAGE axes (fsdp × model), the gradient all-reduce rides every
+    # replica (batch rows span all three axes)
+    tensor = P.comm_sizes_for_mesh({"data": 4, "model": 2})
+    assert tensor["all-gather"] == 2
+    assert tensor["reduce-scatter"] == 2
+    assert tensor["all-reduce"] == 8
+    twod = P.comm_sizes_for_mesh({"data": 1, "fsdp": 4, "model": 2})
+    assert twod["all-gather"] == 8
+    assert twod["reduce-scatter"] == 8
+    assert twod["all-reduce"] == 8
 
 
 # ---- comparison (the gate's FAIL logic) ------------------------------
@@ -672,6 +683,96 @@ def test_fsdp_lowering_prices_comms(fresh_config):
                               comm_sizes=meta["comm_sizes"])
     assert pred["sections_ms"]["comms"] > 0, pred["sections_ms"]
     assert pred["totals"]["collective_bytes"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strategy,axes,widths", [
+    ("tensor", (1, 1, 2), {"fsdp": 1, "model": 2}),
+    ("2d", (1, 2, 2), {"fsdp": 2, "model": 2}),
+])
+def test_tensor_2d_lowerings_price_model_axis(fresh_config, strategy,
+                                              axes, widths):
+    """ISSUE 15: the tensor/2d lowerings carry model-axis collectives
+    in the compiled HLO, the comm sizes ride the storage axes, and
+    the axis_widths helper resolves the (fsdp, model) widths the
+    verdict rows carry."""
+    from eksml_tpu.config import SMOKE_OVERRIDES, finalize_configs
+
+    cfg = fresh_config
+    cfg.update_args(SMOKE_OVERRIDES)
+    cfg.TRAIN.BATCH_SIZE_PER_CHIP = 1
+    cfg.TRAIN.PRECISION = "bfloat16"
+    cfg = finalize_configs(is_training=True)
+    hlo, meta = P.lower_train_step(cfg, batch_size=1, image_size=128,
+                                   strategy=strategy, fsdp_axis=2,
+                                   model_axis=2)
+    assert meta["mesh_shape"] == dict(
+        zip(("data", "fsdp", "model"), axes))
+    assert meta["comm_sizes"]["all-gather"] == (
+        widths["fsdp"] * widths["model"])
+    assert perf_gate.axis_widths(meta["mesh_shape"]) == widths
+    pred = P.predict_from_hlo(hlo, target="v5e",
+                              precision="bfloat16",
+                              comm_sizes=meta["comm_sizes"])
+    assert pred["sections_ms"]["comms"] > 0, pred["sections_ms"]
+    assert pred["totals"]["collective_bytes"] > 0
+
+
+def test_gate_rows_carry_axis_widths(tmp_path):
+    """A 2d verdict row can't be confused with its 1D siblings: the
+    resolved (fsdp, model) widths ride the gate row, derived from the
+    mesh_shape the record already banks (no second stored copy)."""
+    fresh = {"key": "128_b1_2d_bfloat16",
+             "predicted_step_time_ms": 5.0,
+             "sections_ms": {"fwd": 5.0},
+             "components_ms": {"backbone": 5.0},
+             "mesh_shape": {"data": 1, "fsdp": 4, "model": 2}}
+    with open(tmp_path / "perf_pred_128_b1_2d_bfloat16.json",
+              "w") as f:
+        json.dump(fresh, f)
+    row = perf_gate.gate_one(fresh, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "PASS"
+    assert row["axis_widths"] == {"fsdp": 4, "model": 2}
+    # a record without a mesh (serve predict / pre-mesh_shape banks)
+    # stays renderable, just without the widths field
+    legacy = {k: v for k, v in fresh.items() if k != "mesh_shape"}
+    row = perf_gate.gate_one(legacy, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "PASS" and "axis_widths" not in row
+    assert perf_gate.row_axis_widths(
+        {"kind": "predict", "mesh_shape": {}}) is None
+
+
+def test_gate_fails_on_axis_width_mismatch(tmp_path):
+    """pred_key excludes the shard widths, so a lowering at other
+    --fsdp-axis/--model-axis values lands under the SAME baseline
+    file — the gate must refuse the comparison naming both layouts,
+    never emit a bogus time verdict."""
+    base = {"key": "128_b1_2d_bfloat16",
+            "predicted_step_time_ms": 5.0,
+            "sections_ms": {"fwd": 5.0},
+            "components_ms": {"backbone": 5.0},
+            "mesh_shape": {"data": 1, "fsdp": 2, "model": 4}}
+    with open(tmp_path / "perf_pred_128_b1_2d_bfloat16.json",
+              "w") as f:
+        json.dump(base, f)
+    fresh = dict(base)
+    fresh["mesh_shape"] = {"data": 1, "fsdp": 4, "model": 2}
+    row = perf_gate.gate_one(fresh, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "FAIL"
+    assert "axis widths mismatch" in row["error"]
+    assert row["axis_widths"] == {"fsdp": 4, "model": 2}
+    assert row["baseline_axis_widths"] == {"fsdp": 2, "model": 4}
+    # matching widths still gate normally
+    row = perf_gate.gate_one(dict(base), str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "PASS"
 
 
 @pytest.mark.slow

@@ -41,6 +41,15 @@
 # counterpart: a two-thread A→B / B→A lock inversion provably wedges
 # under a test timeout while the SAME source lints to the lock-order
 # finding at the same lines — again one bug, proven once.
+# unit-sharding-2d covers the tensor / 2d (fsdp x tensor) sharding
+# plans (ISSUE 15): model-axis rule placement on the FPN/head output
+# features, plan_mesh axis-product validation, tensor/2d-vs-
+# replicated loss parity on the 8-device mesh, the fsdp(8) → 2d(4x2)
+# elastic restore crossing, and the slow full-width dryrun entries
+# (bit-pinned 8.8102 loss at <= 1/4 replicated state bytes).  The
+# unit-sharding rung excludes these (-k 'not (tensor or 2d)') so the
+# minutes-long full-width dryrun compiles run once per ladder, not
+# twice.
 # unit-serve covers the online serving subsystem (ISSUE 14,
 # eksml_tpu/serve/): AOT bucket-cache warmup with a zero-request-path-
 # compile counter, batch-of-N bit-identical to padded sequential
@@ -77,7 +86,8 @@ RUNGS=(
   "unit-telemetry|tests/test_telemetry.py tests/test_run_report.py"
   "unit-tracing|tests/test_tracing.py tests/test_bench_gate.py"
   "unit-goodput|tests/test_goodput.py tests/test_trace_summary.py"
-  "unit-sharding|tests/test_sharding.py"
+  "unit-sharding|tests/test_sharding.py -k 'not (tensor or 2d)'"
+  "unit-sharding-2d|tests/test_sharding.py -k 'tensor or 2d'"
   "unit-perfgate|tests/test_perf_gate.py"
   "unit-serve|tests/test_serve.py"
   "unit-lint|tests/test_lint.py"

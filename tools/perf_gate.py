@@ -35,7 +35,9 @@ ratios can be trusted.
 
 Usage::
 
-    # CI gate (CPU-only, bounded): 2 geometries x 2 strategies
+    # CI gate (CPU-only, bounded): 2 geometries x 4 strategies
+    # (replicated, fsdp, tensor, 2d — the sharded lowerings price
+    # their fsdp-/model-axis collectives)
     python tools/perf_gate.py
 
     # accept an intended prediction change / first-time banking
@@ -73,10 +75,11 @@ PRED_RUNGS: Dict[str, Dict[str, Any]] = {
                       "remat": True, "param_dtype": "bfloat16"},
 }
 
-#: the CI default: two cheap geometries × both executable strategies —
-#: ~4 tiny-model compiles, bounded minutes on one CPU core
+#: the CI default: two cheap geometries × every executable strategy —
+#: ~8 tiny-model compiles, bounded minutes on one CPU core (the
+#: tensor/2d rungs price the model-axis collectives hermetically)
 DEFAULT_RUNGS = "128_b1,256_b1"
-DEFAULT_STRATEGIES = "replicated,fsdp"
+DEFAULT_STRATEGIES = "replicated,fsdp,tensor,2d"
 
 # Serving (bucket, batch) rungs priced by --serve: the PREDICT step
 # the serving engine's AOT cache warms (eksml_tpu/serve/engine.py),
@@ -134,8 +137,26 @@ def _rung_config(rung: str, precision: str, config_overrides):
     return finalize_configs(is_training=True)
 
 
+def axis_widths(mesh_shape: Dict[str, Any]) -> Dict[str, int]:
+    """Resolved (fsdp, model) widths of a lowered rung's mesh — the
+    verdict-row field that keeps a 2d rung from being confused with
+    its 1D siblings in the bank (same rung name, same strategy
+    string, different shard widths)."""
+    return {"fsdp": int((mesh_shape or {}).get("fsdp", 1)),
+            "model": int((mesh_shape or {}).get("model", 1))}
+
+
+def row_axis_widths(rec: Dict[str, Any]) -> Optional[Dict[str, int]]:
+    """Widths for a verdict row, derived from the ``mesh_shape`` the
+    record already banks (no second copy to drift) — None for serve
+    predict records (no training mesh) and pre-mesh_shape banks."""
+    if rec.get("kind") == "predict" or "mesh_shape" not in rec:
+        return None
+    return axis_widths(rec["mesh_shape"])
+
+
 def predict_rung(rung: str, strategy: str, precision: str,
-                 target: str, fsdp_axis: int = 2,
+                 target: str, fsdp_axis: int = 2, model_axis: int = 2,
                  config_overrides=None) -> Dict[str, Any]:
     """Lower one rung × strategy and price it for ``target`` —
     the fresh-prediction record the gate compares and banks."""
@@ -153,7 +174,7 @@ def predict_rung(rung: str, strategy: str, precision: str,
         cfg, batch_size=spec["batch_size"],
         image_size=spec.get("image_size"),
         pad_hw=spec.get("pad_hw"), strategy=strategy,
-        fsdp_axis=fsdp_axis)
+        fsdp_axis=fsdp_axis, model_axis=model_axis)
     pred = P.predict_from_hlo(hlo, target=target, precision=precision,
                               comm_sizes=meta["comm_sizes"])
     rec = dict(pred)
@@ -242,6 +263,30 @@ def gate_one(fresh: Dict, bank_dir: str, max_regress_pct: float,
         "sections_ms": fresh["sections_ms"],
         "baseline_path": os.path.relpath(path, REPO),
     }
+    widths = row_axis_widths(fresh)
+    if widths is not None:
+        # resolved shard widths ride every verdict row: a 2d rung and
+        # its 1D siblings share rung names, and the bank must never
+        # let one masquerade as the other
+        row["axis_widths"] = widths
+    if base is not None:
+        base_widths = row_axis_widths(base)
+        if (widths is not None and base_widths is not None
+                and widths != base_widths):
+            # pred_key excludes the widths, so a lowering at other
+            # --fsdp-axis/--model-axis values lands under the SAME
+            # baseline file — comparing their times would be a bogus
+            # verdict about nothing; fail naming both layouts
+            row["gate"] = "FAIL"
+            row["baseline_axis_widths"] = base_widths
+            row["error"] = (
+                f"axis widths mismatch: fresh lowering is "
+                f"fsdp={widths['fsdp']} x model={widths['model']} but "
+                f"the banked baseline is fsdp={base_widths['fsdp']} x "
+                f"model={base_widths['model']} — pass the matching "
+                f"--fsdp-axis/--model-axis, or re-bank with "
+                f"--update-baseline if the new widths are intended")
+            return row
     if base is None:
         row["gate"] = "PASS" if allow_missing_baseline else "FAIL"
         row["error"] = (
@@ -266,14 +311,18 @@ def main(argv=None) -> int:
                         f"[%(default)s]")
     p.add_argument("--strategies", default=DEFAULT_STRATEGIES,
                    help="comma list of sharding strategies to lower "
-                        "(replicated, fsdp) [%(default)s]")
+                        "(replicated, fsdp, tensor, 2d) "
+                        "[%(default)s]")
     p.add_argument("--target", default="v5e",
                    help="chip spec the roofline prices for "
                         "(predict.CHIP_SPECS) [%(default)s]")
     p.add_argument("--precision", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--fsdp-axis", type=int, default=2,
-                   help="fsdp axis size for the fsdp lowering "
+                   help="fsdp axis size for the fsdp/2d lowerings "
+                        "(host-platform virtual devices) [%(default)s]")
+    p.add_argument("--model-axis", type=int, default=2,
+                   help="model axis size for the tensor/2d lowerings "
                         "(host-platform virtual devices) [%(default)s]")
     p.add_argument("--bank-dir",
                    default=os.path.join(REPO, "artifacts"),
@@ -317,9 +366,13 @@ def main(argv=None) -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
+            # the 2d lowering shards over fsdp x model jointly — the
+            # host platform must carry the axis PRODUCT
+            n_virtual = max(2, args.fsdp_axis, args.model_axis,
+                            args.fsdp_axis * args.model_axis)
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count="
-                        f"{max(2, args.fsdp_axis)}").strip()
+                        f"{n_virtual}").strip()
         import jax
 
         try:
@@ -374,6 +427,7 @@ def main(argv=None) -> int:
                 fresh = predict_rung(
                     rung, strategy, args.precision, args.target,
                     fsdp_axis=args.fsdp_axis,
+                    model_axis=args.model_axis,
                     config_overrides=args.config)
             # the record's key, NOT pred_key(..., args.precision):
             # a --config TRAIN.PRECISION override re-keyed the
@@ -396,12 +450,16 @@ def main(argv=None) -> int:
                 os.makedirs(args.bank_dir, exist_ok=True)
                 path = baseline_path(args.bank_dir, key)
                 atomic_write_json(path, fresh)
-                verdict["results"].append({
+                banked_row = {
                     "key": key, "gate": "BANKED",
                     "predicted_step_time_ms":
                         fresh["predicted_step_time_ms"],
                     "sections_ms": fresh["sections_ms"],
-                    "baseline_path": os.path.relpath(path, REPO)})
+                    "baseline_path": os.path.relpath(path, REPO)}
+                widths = row_axis_widths(fresh)
+                if widths is not None:
+                    banked_row["axis_widths"] = widths
+                verdict["results"].append(banked_row)
             else:
                 row = gate_one(fresh, args.bank_dir,
                                args.max_regress_pct,
