@@ -326,6 +326,51 @@ TELEMETRY_GOODPUT_DEFAULTS = dict(
     BANK=True,
 )
 
+# Elastic-autoscaling knobs (eksml_tpu/resilience/autoscale.py +
+# tools/eksml_operator.py) — ONE source of truth, same pattern as
+# RESILIENCE_DATA_DEFAULTS: installed under RESILIENCE.AUTOSCALE, and
+# the operator imports the same dict as the fallback for config trees
+# predating the operator.  The decision policy itself is pure
+# (autoscale.decide) — these knobs parameterize it and the actuator
+# loop; charts/autoscaler renders each as --config argv so the
+# values-config-sync lint pins chart ↔ config drift.
+#
+# - INTERVAL_SEC: actuator tick period (capacity read + /metrics
+#   scrape + one decide()).
+# - COOLDOWN_SEC: minimum seconds between GROW relaunches — a grow is
+#   two compiles and a resharded restore, so oscillating capacity
+#   must not thrash them.  Shrinks ignore the cooldown: when chips
+#   are being reclaimed, holding the larger shape means dying by
+#   SIGKILL instead of checkpointing.
+# - GROW_PATIENCE / SHRINK_PATIENCE: consecutive observations a
+#   grow/shrink candidate must survive before actuation (hysteresis
+#   against a flapping capacity signal).
+# - FORECAST_HOLD: preemption-forecast score at or above which growth
+#   is vetoed (the new chips are about to vanish).
+# - MIN_GOODPUT_FOR_GROW: goodput ratio below which growth is vetoed
+#   (a relaunch only adds badput); 0 disables the health veto.
+# - CHIP_OPTIONS: the chip counts the topology ladder is built over,
+#   e.g. (4, 8, 16); () = the operator requires an explicit ladder.
+#   Counts plan_mesh would reject (per-slice divisibility) yield no
+#   rung.
+# - SERVE_*: the ACTIVE half of the serving HPA (charts/serve): the
+#   operator computes desired replicas from the scraped
+#   eksml_serve_queue_depth with the same averageValue math and
+#   clamps to [SERVE_MIN_REPLICAS, SERVE_MAX_REPLICAS];
+#   SERVE_TARGET_QUEUE_DEPTH=0 disables serve scaling.
+RESILIENCE_AUTOSCALE_DEFAULTS = dict(
+    INTERVAL_SEC=30.0,
+    COOLDOWN_SEC=300.0,
+    GROW_PATIENCE=2,
+    SHRINK_PATIENCE=1,
+    FORECAST_HOLD=0.5,
+    MIN_GOODPUT_FOR_GROW=0.0,
+    CHIP_OPTIONS=(),
+    SERVE_TARGET_QUEUE_DEPTH=0.0,
+    SERVE_MIN_REPLICAS=2,
+    SERVE_MAX_REPLICAS=16,
+)
+
 # Online-serving knobs (eksml_tpu/serve/) — ONE source of truth, same
 # pattern as RESILIENCE_DATA_DEFAULTS: installed under SERVE, and
 # serve.engine/serve.batcher import the same dict as the fallback for
@@ -594,6 +639,10 @@ def _define_defaults() -> None:
     # ---- data-ingest robustness (eksml_tpu/data/robust.py) ----------
     for k, v in RESILIENCE_DATA_DEFAULTS.items():
         setattr(_C.RESILIENCE.DATA, k, v)
+
+    # ---- elastic autoscaling (resilience/autoscale.py + operator) ---
+    for k, v in RESILIENCE_AUTOSCALE_DEFAULTS.items():
+        setattr(_C.RESILIENCE.AUTOSCALE, k, v)
 
     # ---- telemetry (eksml_tpu/telemetry/) ---------------------------
     # Registry → cross-host aggregation → OpenMetrics exporter /

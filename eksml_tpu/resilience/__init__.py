@@ -23,6 +23,13 @@ ones, one module per pillar:
   the stalled phase when a step exceeds its deadline.
 - :mod:`retry` — bounded retry/backoff used around
   ``jax.distributed.initialize`` (pods start in arbitrary order).
+- :mod:`autoscale` — the pure decision half of the elastic
+  autoscaling loop (ISSUE 16): capacity + goodput signals → a
+  hold/grow/shrink :class:`~eksml_tpu.resilience.autoscale.ScaleDecision`
+  over the ``plan_mesh``-valid topology ladder, with hysteresis and a
+  relaunch cooldown; ``tools/eksml_operator.py`` actuates it through
+  the :mod:`preemption` forced-checkpoint path (SIGTERM → exit 77 →
+  relaunch, elastic resume resharding the restore).
 
 The *ingest* half of the fault story — transient-I/O retry, per-record
 quarantine with deterministic substitution, decode-pool self-healing,
@@ -35,6 +42,9 @@ tests/test_fault_tolerance.py and tools/chaos_matrix.sh exercises each
 pillar against a real subprocess trainer.
 """
 
+from eksml_tpu.resilience.autoscale import (  # noqa: F401
+    CapacitySignal, HealthSignal, PolicyParams, PolicyState,
+    ScaleDecision, Topology, decide, serve_replicas, topology_ladder)
 from eksml_tpu.resilience.integrity import (  # noqa: F401
     list_manifest_steps, manifest_path, prune_manifests, quarantine_step,
     verify_step, write_manifest)

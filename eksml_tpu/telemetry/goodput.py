@@ -334,7 +334,10 @@ def recover_downtime(logdir: Optional[str], host_id: int = 0
     it by the time fit runs); its downtime is the gap back to the
     previous segment's last observable activity — its newest event,
     or a newer checkpoint-commit mtime (a SIGKILLed segment's last
-    trace).  First launch → (0, run_start or None)."""
+    trace).  When the previous segment's events are missing ENTIRELY
+    (killed before the recorder's first flush) the newest
+    checkpoint-commit mtime alone still credits the gap.  A genuine
+    first launch (no prior checkpoints) → (0, run_start or None)."""
     if not logdir:
         return 0.0, None
     events = _read_jsonl(os.path.join(logdir,
@@ -346,7 +349,17 @@ def recover_downtime(logdir: Optional[str], host_id: int = 0
     cur = events[starts[-1]]
     cur_t = float(cur.get("time", 0.0))
     if len(starts) < 2:
-        return 0.0, cur_t or None
+        # the previous segment left NO events at all (SIGKILL before
+        # the recorder's first flush, or an events file lost with the
+        # local disk) — its newest checkpoint-commit mtime is still on
+        # the shared filesystem and is the only activity trace left.
+        # A genuine first launch has no committed checkpoints either,
+        # so this stays (0, start) there.
+        prev_end = max((t for t in checkpoint_commit_times(logdir)
+                        if t < cur_t), default=0.0)
+        if prev_end <= 0.0 or cur_t <= prev_end:
+            return 0.0, cur_t or None
+        return cur_t - prev_end, cur_t
     prev_events = events[starts[-2]:starts[-1]]
     prev_end = max((float(e.get("time", 0.0)) for e in prev_events),
                    default=0.0)

@@ -1,0 +1,417 @@
+"""Autoscaling: pure decision policy + operator plumbing (ISSUE 16).
+
+Fast half of the autoscaling coverage (``unit-autoscale`` rung in
+tools/chaos_matrix.sh; the subprocess half is
+test_fault_tolerance.py::test_operator_capacity_wave):
+
+- ladder derivation mirrors ``plan_mesh``'s divisibility contract, and
+  EVERY emitted rung is pinned against the real ``plan_mesh`` — the
+  policy can only ever name a launchable topology;
+- ``decide()`` as a capacity-trace simulator: grow/shrink/hold,
+  hysteresis (patience streaks), cooldown, forecast + goodput vetoes,
+  thrash-resistance under oscillating capacity — all as pure-function
+  table tests with an explicit fake clock;
+- purity is pinned STATICALLY too: the module source must not touch
+  wall-clock or RNG (the acceptance criterion is "no time.time/RNG
+  inside decide()", and grepping the source catches a regression in
+  any helper decide() calls);
+- operator plumbing that needs no subprocess: the OpenMetrics scrape
+  parser, capacity providers (file/env/kubectl-parse), the
+  kubectl transition command builders (graceful deletion — never
+  ``--force``), the local actuator's command/env synthesis, and the
+  preregistered ``eksml_autoscale_*`` series.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from eksml_tpu.parallel.sharding import plan_mesh
+from eksml_tpu.resilience import autoscale
+from eksml_tpu.resilience.autoscale import (CapacitySignal,
+                                            HealthSignal, PolicyParams,
+                                            PolicyState, Topology,
+                                            decide, serve_replicas,
+                                            topology_ladder)
+from eksml_tpu.telemetry.exporter import render_openmetrics
+from eksml_tpu.telemetry.registry import MetricRegistry
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+import eksml_operator as operator_mod  # noqa: E402
+
+
+# ---- topology ladder -------------------------------------------------
+
+
+def test_ladder_fsdp_sorted_and_named():
+    ladder = topology_ladder((8, 4, 2), strategy="fsdp")
+    assert [t.name for t in ladder] == ["fsdp2", "fsdp4", "fsdp8"]
+    assert [t.chips for t in ladder] == [2, 4, 8]
+    assert all(t.fsdp_axis == t.chips for t in ladder)
+
+
+def test_ladder_skips_invalid_counts():
+    # multi-slice: per-slice device count must be integral
+    ladder = topology_ladder((4, 6, 8), strategy="fsdp", num_slices=4)
+    assert [t.chips for t in ladder] == [4, 8]
+    # tensor: the model axis must divide the per-slice count
+    ladder = topology_ladder((4, 6, 8), strategy="tensor",
+                             model_axis=4)
+    assert [t.chips for t in ladder] == [4, 8]
+    # 2d: fsdp x model product must divide per-slice count
+    ladder = topology_ladder((2, 4, 8), strategy="2d", model_axis=2)
+    assert [t.name for t in ladder] == ["2d1x2-2", "2d2x2-4",
+                                        "2d4x2-8"]
+    # nothing fits -> empty tuple, never an invalid rung
+    assert topology_ladder((3, 5), strategy="tensor",
+                           model_axis=2) == ()
+
+
+def test_ladder_rejects_unknown_strategy():
+    with pytest.raises(ValueError, match="strategy"):
+        topology_ladder((4,), strategy="pipeline")
+
+
+@pytest.mark.parametrize("strategy,model_axis", [
+    ("replicated", 1), ("fsdp", 1), ("tensor", 2), ("2d", 2)])
+def test_every_rung_accepted_by_plan_mesh(fresh_config, strategy,
+                                          model_axis):
+    """The ISSUE pin: every topology the ladder emits must be
+    launchable — ``plan_mesh`` (the real validator the trainer runs
+    at startup) accepts the rung's exact config at its exact device
+    count, no exceptions."""
+    ladder = topology_ladder((1, 2, 4, 6, 8, 12, 16),
+                             strategy=strategy, model_axis=model_axis)
+    assert ladder, "ladder unexpectedly empty"
+    for topo in ladder:
+        fresh_config.TRAIN.SHARDING.STRATEGY = topo.strategy
+        fresh_config.TRAIN.SHARDING.FSDP_AXIS_SIZE = topo.fsdp_axis
+        fresh_config.TRAIN.SHARDING.MODEL_AXIS_SIZE = topo.model_axis
+        fresh_config.TPU.MESH_SHAPE = ()
+        shape, _axes = plan_mesh(fresh_config, topo.chips)
+        # replicated passes the (empty) legacy mesh through untouched;
+        # every sharded strategy must derive a shape covering exactly
+        # this rung's chips
+        if topo.strategy != "replicated":
+            assert _prod(shape) == topo.chips
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+def test_config_overrides_hold_global_batch():
+    topo = Topology("fsdp4", 4, "fsdp", fsdp_axis=4)
+    items = topo.config_overrides(global_batch=8)
+    assert "TRAIN.NUM_CHIPS=4" in items
+    assert "TRAIN.SHARDING.FSDP_AXIS_SIZE=4" in items
+    assert "TRAIN.BATCH_SIZE_PER_CHIP=2" in items
+    with pytest.raises(ValueError, match="divide"):
+        Topology("fsdp3", 3).config_overrides(global_batch=8)
+    # tensor/2d pin the model axis instead of / as well as fsdp
+    t2d = Topology("2d2x2-4", 4, "2d", fsdp_axis=2, model_axis=2)
+    items = t2d.config_overrides()
+    assert "TRAIN.SHARDING.MODEL_AXIS_SIZE=2" in items
+    assert "TRAIN.SHARDING.FSDP_AXIS_SIZE=2" in items
+
+
+# ---- decide(): capacity-trace simulator ------------------------------
+
+LADDER = topology_ladder((4, 8), strategy="fsdp")
+CALM = HealthSignal()
+
+
+def run_trace(trace, state, params, t0=1000.0, dt=10.0,
+              health=CALM):
+    """Feed a list of (chips, forecast) observations through decide()
+    with a deterministic fake clock; return the decision list."""
+    decisions = []
+    now = t0
+    for chips, forecast in trace:
+        dec, state = decide(state, CapacitySignal(chips, forecast),
+                            health, LADDER, params, now)
+        decisions.append(dec)
+        now += dt
+    return decisions, state
+
+
+def _at8(t=0.0):
+    return PolicyState(LADDER[-1], last_change_t=t)
+
+
+def test_hold_when_capacity_matches():
+    decs, state = run_trace([(8, 0.0)] * 3, _at8(),
+                            PolicyParams(cooldown_sec=0))
+    assert [d.action for d in decs] == ["hold"] * 3
+    assert state.grow_streak == 0 and state.shrink_streak == 0
+
+
+def test_shrink_is_immediate_and_ignores_cooldown():
+    # last_change_t == t0: the cooldown window is fully open, but a
+    # capacity LOSS must not wait it out (SIGKILL beats checkpointing)
+    params = PolicyParams(cooldown_sec=10_000, shrink_patience=1)
+    decs, state = run_trace([(4, 0.0)], _at8(t=1000.0), params)
+    assert decs[0].action == "shrink"
+    assert decs[0].target.name == "fsdp4"
+    assert state.topology.chips == 4
+    assert state.last_change_t == 1000.0
+
+
+def test_shrink_hysteresis_waits_for_patience():
+    params = PolicyParams(cooldown_sec=0, shrink_patience=2)
+    decs, _ = run_trace([(4, 0.0), (4, 0.0)], _at8(), params)
+    assert [d.action for d in decs] == ["hold", "shrink"]
+    assert "hysteresis" in decs[0].reason
+
+
+def test_grow_needs_patience_then_cooldown():
+    params = PolicyParams(cooldown_sec=25.0, grow_patience=2)
+    state = PolicyState(LADDER[0], last_change_t=1000.0)  # at fsdp4
+    # t=1000: streak 1/2 -> hold; t=1010: patience met but 15s of
+    # cooldown left -> hold; t=1020: still 5s left -> hold;
+    # t=1030: clear -> grow
+    decs, state = run_trace([(8, 0.0)] * 4, state, params)
+    assert [d.action for d in decs] == ["hold", "hold", "hold",
+                                       "grow"]
+    assert "hysteresis" in decs[0].reason
+    assert "cooldown" in decs[1].reason and "cooldown" in decs[2].reason
+    assert decs[3].target.name == "fsdp8"
+    assert state.topology.chips == 8
+
+
+def test_forecast_vetoes_growth_and_resets_streak():
+    params = PolicyParams(cooldown_sec=0, grow_patience=2,
+                          forecast_hold=0.5)
+    state = PolicyState(LADDER[0])
+    # two grow-capable ticks build the streak, then a stormy forecast
+    # resets it — growth needs patience rebuilt from scratch after
+    decs, _ = run_trace(
+        [(8, 0.0), (8, 0.9), (8, 0.0), (8, 0.0)], state, params)
+    assert [d.action for d in decs] == ["hold", "hold", "hold",
+                                       "grow"]
+    assert "forecast" in decs[1].reason
+
+
+def test_goodput_veto_only_when_enabled_and_known():
+    params = PolicyParams(cooldown_sec=0, grow_patience=1,
+                          min_goodput_for_grow=0.5)
+    state = PolicyState(LADDER[0])
+    sick = HealthSignal(goodput_ratio=0.2)
+    dec, _ = decide(state, CapacitySignal(8), sick, LADDER, params,
+                    1000.0)
+    assert dec.action == "hold" and "goodput" in dec.reason
+    # unknown health (scrape failed mid-relaunch) never vetoes
+    dec, _ = decide(state, CapacitySignal(8), HealthSignal(), LADDER,
+                    params, 1000.0)
+    assert dec.action == "grow"
+    # veto disabled (the chaos-run default): sick ratio still grows
+    dec, _ = decide(state, CapacitySignal(8), sick, LADDER,
+                    PolicyParams(cooldown_sec=0, grow_patience=1),
+                    1000.0)
+    assert dec.action == "grow"
+
+
+def test_no_fit_holds_and_resets_streaks():
+    state = PolicyState(LADDER[-1], grow_streak=1, shrink_streak=0)
+    dec, nxt = decide(state, CapacitySignal(2), CALM, LADDER,
+                      PolicyParams(), 1000.0)
+    assert dec.action == "hold"
+    assert "no ladder rung fits 2" in dec.reason
+    assert nxt.grow_streak == 0 and nxt.shrink_streak == 0
+
+
+def test_oscillating_capacity_cannot_thrash():
+    """The headline hysteresis property: capacity flapping 8/4 every
+    tick with patience 2 produces ZERO transitions — each flip resets
+    the other direction's streak before it can mature."""
+    params = PolicyParams(cooldown_sec=0, grow_patience=2,
+                          shrink_patience=2)
+    trace = [(4, 0.0), (8, 0.0)] * 10
+    decs, state = run_trace(trace, _at8(), params)
+    assert [d.action for d in decs] == ["hold"] * 20
+    assert state.topology.chips == 8
+
+
+def test_decide_is_deterministic():
+    state = PolicyState(LADDER[0], last_change_t=990.0, grow_streak=1)
+    args = (state, CapacitySignal(8, 0.1),
+            HealthSignal(goodput_ratio=0.7, badput_s={"restart": 3.0}),
+            LADDER, PolicyParams(cooldown_sec=5.0), 1000.0)
+    a_dec, a_state = decide(*args)
+    b_dec, b_state = decide(*args)
+    assert a_dec == b_dec and a_state == b_state
+    assert a_dec.to_dict() == b_dec.to_dict()
+
+
+def test_policy_module_is_statically_pure():
+    """No wall-clock, RNG, filesystem or env reads anywhere in the
+    policy module — decide() must be replayable bit-for-bit from its
+    banked inputs (acceptance criterion)."""
+    src = open(autoscale.__file__.rstrip("c")).read()
+    for needle in ("time.time(", "import time", "import random",
+                   "datetime.now", "os.environ", "open("):
+        assert needle not in src, f"{needle!r} found in autoscale.py"
+
+
+# ---- serve_replicas (active half of the serve HPA) -------------------
+
+
+@pytest.mark.parametrize("depth,current,target,lo,hi,want", [
+    (8.0, 2, 8.0, 2, 16, 2),     # at target: steady state
+    (16.0, 2, 8.0, 2, 16, 4),    # 2x depth -> 2x replicas
+    (20.0, 3, 8.0, 2, 16, 8),    # ceil(3 * 20/8) = 8
+    (0.0, 4, 8.0, 2, 16, 2),     # idle fleet collapses to the floor
+    (100.0, 8, 8.0, 2, 16, 16),  # clamped at the ceiling
+    (5.0, 4, 0.0, 2, 16, 4),     # target 0 disables: clamp current
+])
+def test_serve_replicas_table(depth, current, target, lo, hi, want):
+    assert serve_replicas(depth, current, target, lo, hi) == want
+
+
+# ---- operator plumbing (no subprocess) -------------------------------
+
+EXPO = """\
+# HELP eksml_goodput_ratio productive fraction
+# TYPE eksml_goodput_ratio gauge
+eksml_goodput_ratio 0.83
+eksml_badput_seconds_total{bucket="restart"} 12.5
+eksml_badput_seconds_total{bucket="checkpoint_save"} 3.25
+eksml_resilience_preemptions_total 2
+eksml_hosts_step_time_ms_straggler 1.7
+eksml_serve_queue_depth 6
+not a sample line
+"""
+
+
+def test_parse_openmetrics_and_health():
+    fams = operator_mod.parse_openmetrics(EXPO)
+    assert fams["eksml_goodput_ratio"] == [({}, 0.83)]
+    assert ({"bucket": "restart"}, 12.5) in fams[
+        "eksml_badput_seconds_total"]
+    health = operator_mod.health_from_metrics(fams)
+    assert health.goodput_ratio == pytest.approx(0.83)
+    assert health.badput_s["checkpoint_save"] == pytest.approx(3.25)
+    assert health.preemptions == 2.0
+    assert health.stragglers == pytest.approx(1.7)
+    # partial exposition (old trainer): all-defaults signal, no raise
+    empty = operator_mod.health_from_metrics(
+        operator_mod.parse_openmetrics("up 1\n"))
+    assert empty.goodput_ratio is None and empty.preemptions == 0.0
+
+
+def test_file_capacity_provider(tmp_path):
+    path = str(tmp_path / "cap.json")
+    prov = operator_mod.FileCapacityProvider(path)
+    assert prov.read() is None  # absent
+    with open(path, "w") as f:
+        f.write('{"available_chips": 12, "preemption_forecast": 0.3')
+    assert prov.read() is None  # torn mid-rewrite
+    with open(path, "w") as f:
+        json.dump({"available_chips": 12,
+                   "preemption_forecast": 0.3}, f)
+    cap = prov.read()
+    assert cap == CapacitySignal(12, 0.3)
+
+
+def test_env_capacity_provider(monkeypatch):
+    prov = operator_mod.EnvCapacityProvider()
+    monkeypatch.delenv("EKSML_AVAILABLE_CHIPS", raising=False)
+    assert prov.read() is None
+    monkeypatch.setenv("EKSML_AVAILABLE_CHIPS", "16")
+    monkeypatch.setenv("EKSML_PREEMPTION_FORECAST", "0.25")
+    assert prov.read() == CapacitySignal(16, 0.25)
+    monkeypatch.setenv("EKSML_AVAILABLE_CHIPS", "not-a-number")
+    assert prov.read() is None
+
+
+def test_kubectl_capacity_parse_counts_only_ready_nodes():
+    prov = operator_mod.KubectlCapacityProvider(selector="pool=tpu")
+    doc = {"items": [
+        {"status": {"conditions": [{"type": "Ready",
+                                    "status": "True"}],
+                    "allocatable": {"google.com/tpu": "8"}}},
+        {"status": {"conditions": [{"type": "Ready",
+                                    "status": "False"}],
+                    "allocatable": {"google.com/tpu": "8"}}},
+        {"status": {"conditions": [{"type": "Ready",
+                                    "status": "True"}],
+                    "allocatable": {}}},  # CPU-only node
+    ]}
+    assert prov.parse(doc) == CapacitySignal(8)
+    assert prov.command() == ["kubectl", "get", "nodes", "-o",
+                              "json", "-l", "pool=tpu"]
+
+
+def test_kubectl_transition_is_graceful():
+    """The transition must ride the forced-checkpoint path: annotate
+    the JobSet with the decided topology, then a GRACEFUL pod delete
+    (SIGTERM inside the grace window) — never --force/--grace-period=0
+    (that is the SIGKILL path elastic resume exists to avoid)."""
+    topo = Topology("fsdp4", 4, "fsdp", fsdp_axis=4)
+    cmds = operator_mod.kubectl_transition_cmds(
+        "maskrcnn", "kubeflow", topo, global_batch=8)
+    patch_cmd, delete_cmd = cmds
+    assert patch_cmd[:6] == ["kubectl", "-n", "kubeflow", "patch",
+                             "jobset", "maskrcnn"]
+    patch = json.loads(patch_cmd[-1])
+    ann = patch["metadata"]["annotations"]
+    assert ann["eksml.dev/target-chips"] == "4"
+    assert "TRAIN.BATCH_SIZE_PER_CHIP=2" in ann[
+        "eksml.dev/target-config"]
+    assert "delete" in delete_cmd and "pod" in delete_cmd
+    joined = " ".join(delete_cmd)
+    assert "--force" not in joined and "--grace-period" not in joined
+    assert "jobset.sigs.k8s.io/jobset-name=maskrcnn" in joined
+    scale = operator_mod.kubectl_serve_scale_cmd(
+        "eksml-serve", "kubeflow", 5)
+    assert scale[-1] == "--replicas=5"
+
+
+def test_local_actuator_command_and_env(tmp_path, monkeypatch):
+    act = operator_mod.LocalTrainerActuator(
+        str(tmp_path), ["TRAIN.LOG_PERIOD=1"], global_batch=8,
+        fake_chips=True, synthetic=True)
+    topo = Topology("fsdp4", 4, "fsdp", fsdp_axis=4)
+    cmd = act.command(topo)
+    assert cmd[1:3] == ["-m", "eksml_tpu.train"]
+    assert "--synthetic" in cmd
+    assert "TRAIN.NUM_CHIPS=4" in cmd
+    assert "TRAIN.BATCH_SIZE_PER_CHIP=2" in cmd
+    # fake-chips substitutes ONLY the device-count flag, preserving
+    # the rest of an inherited XLA_FLAGS
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        "--xla_force_host_platform_device_count=8 --xla_foo=1")
+    env = act.environment(topo)
+    assert "--xla_force_host_platform_device_count=4" in env[
+        "XLA_FLAGS"]
+    assert "--xla_foo=1" in env["XLA_FLAGS"]
+    assert env["XLA_FLAGS"].count("device_count") == 1
+    assert act.poll() is None and not act.running
+    assert act.stop() is None  # no child: a no-op, never a raise
+
+
+def test_preregistered_autoscale_series_scrape_as_zero():
+    """The PR-4 convention: a healthy FIRST scrape shows the whole
+    eksml_autoscale_* family at 0 — dashboards and alerts key on
+    series existence, not just values."""
+    reg = MetricRegistry()
+    operator_mod.Operator._preregister(reg)
+    text = render_openmetrics(reg)
+    for needle in (
+            'eksml_autoscale_decisions_total{action="hold"} 0',
+            'eksml_autoscale_decisions_total{action="grow"} 0',
+            'eksml_autoscale_decisions_total{action="shrink"} 0',
+            "eksml_autoscale_target_chips 0",
+            "eksml_autoscale_available_chips 0",
+            "eksml_autoscale_relaunches_total 0",
+            "eksml_autoscale_serve_target_replicas 0"):
+        assert needle in text, f"missing preregistered series "\
+                               f"{needle!r}"

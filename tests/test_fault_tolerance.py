@@ -28,6 +28,16 @@ resilience layer (eksml_tpu/resilience/); each rung here drives a real
                       (checkpoint_resharded event + saved→current
                       diff) and the loss stream continues from the
                       forced checkpoint (ISSUE 10).
+  proc-capacity-wave  the autoscaling operator (tools/eksml_operator)
+                      drives an UNATTENDED 8→4→8 capacity wave for
+                      two full cycles: a file capacity provider flips,
+                      the operator's pure policy decides, and every
+                      transition rides the forced-checkpoint path
+                      (SIGTERM → exit 77 → relaunch at the decided
+                      topology, elastic resume resharding); the loss
+                      stream stays continuous throughout and the
+                      merged goodput ledger attributes the
+                      between-relaunch downtime (ISSUE 16).
 
 Data-ingest rungs (eksml_tpu/data/robust.py, ISSUE 2):
 
@@ -765,6 +775,178 @@ def test_elastic_resume_grow_shrink(tmp_path, compile_cache):
     report = run_report.render_report(logdir)
     assert "## Elastic resume (topology changes)" in report
     assert "num_devices: 4 -> 8" in report
+
+
+# ---- rung 4d: autoscaling operator capacity wave (ISSUE 16) ----------
+
+
+def _autoscale_rows(logdir, host=0):
+    """Banked operator decisions (<logdir>/autoscale-host<i>.jsonl)."""
+    path = os.path.join(logdir, f"autoscale-host{host}.jsonl")
+    rows = []
+    if os.path.exists(path):
+        for line in open(path):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
+
+
+def _set_capacity(path, chips):
+    """Atomic capacity-file rewrite (the wave driver's half of the
+    FileCapacityProvider torn-read contract)."""
+    with open(path + ".tmp", "w") as f:
+        json.dump({"available_chips": chips,
+                   "preemption_forecast": 0.0}, f)
+    os.replace(path + ".tmp", path)
+
+
+@pytest.mark.slow
+def test_operator_capacity_wave(tmp_path, compile_cache):
+    """Headline chaos rung (ISSUE 16): the autoscaling operator closes
+    the resilience loop UNATTENDED.  A file capacity provider flips
+    8→4→8→4→8 (two full cycles); each flip the operator's pure policy
+    decides shrink/grow and actuates through the forced-checkpoint
+    path — SIGTERM, trainer checkpoints and exits 77, relaunch at the
+    decided topology, elastic resume reshards.  The test only moves
+    the capacity file and watches the evidence trail: every transition
+    banked with exit code 77, a reshard event per crossing, the loss
+    stream contiguous and finite across all five segments, the merged
+    goodput ledger attributing bounded between-relaunch downtime, and
+    run_report's Autoscaling section joining it all."""
+    logdir = str(tmp_path / "run")
+    os.makedirs(logdir)
+    cap = str(tmp_path / "capacity.json")
+    _set_capacity(cap, 8)
+    t_wave0 = time.time()
+
+    # a long schedule the wave runs inside; the operator is stopped by
+    # the test, not by schedule exhaustion
+    train_cfg = [c for c in TINY if "MAX_EPOCHS" not in c] + [
+        "TRAIN.MAX_EPOCHS=40", "TRAIN.SHARDING.STRATEGY=fsdp"]
+    env = dict(os.environ)
+    env.update({"EKSML_PLATFORM": "cpu",
+                "JAX_COMPILATION_CACHE_DIR": compile_cache})
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, os.path.join(repo, "tools",
+                                        "eksml_operator.py"),
+           "--logdir", logdir, "--mode", "local",
+           "--capacity-file", cap, "--fake-chips", "--synthetic",
+           "--global-batch", "8", "--interval", "0.5",
+           "--initial-chips", "8",
+           "--config", "RESILIENCE.AUTOSCALE.CHIP_OPTIONS=(4,8)",
+           "RESILIENCE.AUTOSCALE.COOLDOWN_SEC=0",
+           "RESILIENCE.AUTOSCALE.GROW_PATIENCE=1",
+           "RESILIENCE.AUTOSCALE.SHRINK_PATIENCE=1",
+           "--train-config"] + train_cfg
+    op_log = str(tmp_path / "operator.log")
+    with open(op_log, "w") as logf:  # file, not pipe (see _launch)
+        proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                                stderr=subprocess.STDOUT, cwd=repo)
+
+    def relaunches():
+        return [r for r in _autoscale_rows(logdir)
+                if r.get("kind") == "relaunch"]
+
+    deadline = time.time() + 840
+
+    def wait_for(pred, what):
+        while time.time() < deadline:
+            if pred():
+                return
+            if proc.poll() is not None:
+                pytest.fail(f"operator exited rc={proc.returncode} "
+                            f"waiting for {what}:\n"
+                            + open(op_log).read()[-2000:])
+            time.sleep(0.5)
+        pytest.fail(f"timed out waiting for {what}")
+
+    try:
+        wait_for(lambda: len(_steps_logged(logdir)) >= 2,
+                 "first steps at 8 chips")
+        # two full 8→4→8 cycles, each crossing confirmed by a banked
+        # relaunch AND resumed step progress before the next flip
+        for i, (chips, want) in enumerate(
+                [(4, 1), (8, 2), (4, 3), (8, 4)]):
+            _set_capacity(cap, chips)
+            wait_for(lambda: len(relaunches()) >= want,
+                     f"relaunch {want} (cap={chips})")
+            n0 = len(_steps_logged(logdir))
+            wait_for(lambda: len(_steps_logged(logdir)) >= n0 + 2,
+                     f"steps after relaunch {want}")
+        # the operator's own exporter is live mid-wave, with the whole
+        # preregistered eksml_autoscale_* family present
+        port = int(open(os.path.join(
+            logdir, "telemetry-operator.port")).read())
+        import urllib.request
+        expo = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rc = proc.wait(timeout=30)
+    assert rc == 0, open(op_log).read()[-2000:]
+    t_wave1 = time.time()
+
+    # every transition went through the forced-checkpoint path: the
+    # stopped trainer exited the documented resumable code each time
+    from eksml_tpu.config import config as global_config
+
+    waves = relaunches()
+    assert len(waves) >= 4, waves
+    assert [w["action"] for w in waves[:4]] == [
+        "shrink", "grow", "shrink", "grow"], waves
+    assert all(w["exit_code"]
+               == global_config.RESILIENCE.PREEMPT_EXIT_CODE
+               for w in waves), waves
+    assert [w["target_chips"] for w in waves[:4]] == [4, 8, 4, 8]
+
+    # each crossing resharded the restore (ISSUE 10's machinery)
+    kinds = _event_kinds(logdir)
+    assert kinds.count("checkpoint_resharded") >= 4, kinds
+    # the operator's own flight stream tells the decision story
+    op_kinds = _event_kinds(logdir, host="op")
+    assert op_kinds[0] == "scale_launch"
+    assert op_kinds.count("scale_relaunch") >= 4
+    assert op_kinds.count("scale_decision") >= 4
+    assert "scale_hold" in op_kinds  # steady-state ticks recorded too
+
+    # loss stream: contiguous from step 1, no repeats, all finite
+    steps = _steps_logged(logdir)
+    assert steps == list(range(1, len(steps) + 1)), steps
+    assert len(steps) >= 10, steps  # progress in all five segments
+    rows = {r["step"]: r["total_loss"] for r in _metric_rows(logdir)
+            if "total_loss" in r}
+    assert all(math.isfinite(v) for v in rows.values()), rows
+
+    # operator metrics scraped live: decisions counted by action,
+    # relaunches counted, target published
+    assert 'eksml_autoscale_decisions_total{action="shrink"}' in expo
+    assert 'eksml_autoscale_decisions_total{action="grow"}' in expo
+    assert "eksml_autoscale_relaunches_total" in expo
+    assert "eksml_autoscale_target_chips 8" in expo
+
+    # the merged goodput ledger attributes the wave's downtime:
+    # nonzero (four relaunch gaps) but bounded by the rung wall
+    from eksml_tpu.telemetry.goodput import build_ledger
+
+    ledger = build_ledger(logdir)
+    assert len(ledger["segments"]) >= 5, ledger["segments"]
+    down = ledger["downtime"]["total_s"]
+    assert 0.0 < down < (t_wave1 - t_wave0), (down,
+                                              t_wave1 - t_wave0)
+    # and run_report joins the decision timeline against it
+    from tools import run_report
+
+    report = run_report.render_report(logdir)
+    assert "## Autoscaling" in report
+    assert "shrink" in report and "grow" in report
 
 
 # ---- rungs 5-7: data-ingest faults (loader level, in-process) --------

@@ -285,6 +285,31 @@ def test_recover_downtime_uses_checkpoint_mtime(tmp_path):
     assert down == pytest.approx(50.0)
 
 
+def test_recover_downtime_ckpt_mtime_only(tmp_path):
+    """The previous segment died before the recorder's FIRST flush
+    (SIGKILL mid-warmup, or the events file went down with a local
+    disk) — the relaunch sees a single run_start but committed
+    checkpoints exist.  The newest commit mtime alone credits the
+    gap (ISSUE 16 satellite)."""
+    logdir = str(tmp_path)
+    _write_events(logdir, [
+        {"time": 300.0, "kind": "run_start", "host": 0}])
+    for step, mtime in (("2", 180.0), ("4", 250.0)):
+        step_dir = tmp_path / "checkpoints" / step
+        step_dir.mkdir(parents=True)
+        os.utime(step_dir, (mtime, mtime))
+    down, seg_start = recover_downtime(logdir, 0)
+    assert down == pytest.approx(50.0)  # newest commit, not oldest
+    assert seg_start == pytest.approx(300.0)
+    # a commit NEWER than the current start (clock skew on shared
+    # storage) must not produce negative downtime
+    late = tmp_path / "checkpoints" / "6"
+    late.mkdir()
+    os.utime(late, (400.0, 400.0))
+    down, _ = recover_downtime(logdir, 0)
+    assert down == pytest.approx(50.0)
+
+
 def test_recover_downtime_first_launch_is_zero(tmp_path):
     assert recover_downtime(str(tmp_path), 0) == (0.0, None)
     _write_events(str(tmp_path), [

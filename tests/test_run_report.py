@@ -324,3 +324,44 @@ def test_effective_mfu_skips_serve_predictions(tmp_path):
     out = goodput_report.effective_mfu(0.9, art_dir)
     assert out.get("prediction") == \
         "perf_pred_128_b1_replicated_bfloat16.json"
+
+
+def test_autoscale_section_joins_decisions_and_downtime(tmp_path):
+    """The Autoscaling section (ISSUE 16): the operator's banked
+    decision trail tabulated (holds compressed to a count, every
+    transition shown with its exit code) and joined against the
+    goodput ledger; degrades to a pointer when no operator ran."""
+    logdir = str(tmp_path / "run")
+    os.makedirs(logdir)
+    # degraded: no bank -> pointer, never a crash
+    report = run_report.render_report(logdir)
+    assert "## Autoscaling (operator decision trail)" in report
+    assert "No autoscale-host*.jsonl found" in report
+    rows = [
+        {"time": 100.0, "kind": "launch", "target": "fsdp8",
+         "target_chips": 8},
+        {"time": 110.0, "kind": "decision", "action": "hold",
+         "target": "fsdp8", "target_chips": 8,
+         "reason": "capacity matches current topology"},
+        {"time": 120.0, "kind": "decision", "action": "shrink",
+         "target": "fsdp4", "target_chips": 4,
+         "reason": "capacity 4 < current 8 chips"},
+        {"time": 121.0, "kind": "relaunch", "action": "shrink",
+         "target": "fsdp4", "target_chips": 4, "exit_code": 77,
+         "relaunch_gap_s": 0.4},
+    ]
+    with open(os.path.join(logdir, "autoscale-host0.jsonl"),
+              "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    report = run_report.render_report(logdir)
+    assert ("2 decision(s): 1 hold, 0 grow, 1 shrink; "
+            "1 relaunch(es), 1 via the forced-checkpoint path "
+            "(trainer exit 77)." in report)
+    lines = report.splitlines()
+    # holds are counted, not tabulated; transitions carry exit + gap
+    assert not any("| decision | hold |" in ln for ln in lines)
+    assert any("| decision | shrink | fsdp4 | 4 | - | capacity 4"
+               in ln for ln in lines)
+    assert any("| relaunch | shrink | fsdp4 | 4 | 77 "
+               "| relaunch gap 0.4 s |" in ln for ln in lines)

@@ -60,6 +60,18 @@
 # takes SIGTERM mid-load — zero dropped in-flight requests, 503 on
 # new ones, clean exit 0, and the mid-run /metrics scrape parses the
 # eksml_serve_* family set as strict OpenMetrics.
+# unit-autoscale covers the elastic-autoscaling decision half (ISSUE
+# 16, eksml_tpu/resilience/autoscale.py + tools/eksml_operator.py):
+# plan_mesh-pinned topology ladders, the pure decide() driven through
+# capacity-trace table tests (grow/shrink/hold, hysteresis streaks,
+# cooldown, forecast + goodput vetoes, thrash-resistance), static
+# purity of the policy module, and the operator's scrape/capacity/
+# kubectl plumbing.  proc-capacity-wave is the headline runtime
+# proof: the operator drives an UNATTENDED 8→4→8 fake-chip capacity
+# wave for two full cycles — every transition through the forced-
+# checkpoint path (SIGTERM → exit 77 → relaunch, elastic resume
+# resharding), the loss stream continuous throughout, and the merged
+# goodput ledger attributing the bounded between-relaunch downtime.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # (or `-m eksml_tpu.serve`) processes and are marked slow (excluded
 # from tier-1); the unit and data-* rungs run in seconds.  Everything runs under
@@ -90,6 +102,7 @@ RUNGS=(
   "unit-sharding-2d|tests/test_sharding.py -k 'tensor or 2d'"
   "unit-perfgate|tests/test_perf_gate.py"
   "unit-serve|tests/test_serve.py"
+  "unit-autoscale|tests/test_autoscale.py"
   "unit-lint|tests/test_lint.py"
   "unit-lint-spmd|tests/test_lint_spmd.py"
   "unit-lint-concurrency|tests/test_lint_concurrency.py"
@@ -100,6 +113,7 @@ RUNGS=(
   "proc-sigkill-resume|tests/test_fault_tolerance.py::test_sigkill_then_resume"
   "proc-sigterm-graceful|tests/test_fault_tolerance.py::test_sigterm_graceful_preempt_then_resume"
   "proc-elastic-resume|tests/test_fault_tolerance.py::test_elastic_resume_grow_shrink"
+  "proc-capacity-wave|tests/test_fault_tolerance.py::test_operator_capacity_wave"
   "proc-corrupt-latest|tests/test_fault_tolerance.py::test_corrupt_latest_checkpoint_falls_back"
   "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"
   "proc-debugz-profile|tests/test_fault_tolerance.py::test_debugz_profile_capture_midrun_with_tracing"

@@ -1,0 +1,863 @@
+#!/usr/bin/env python
+"""Elastic autoscaling operator: actuate the pure scale policy.
+
+The actuator half of ISSUE 16 (the decision half is
+``eksml_tpu/resilience/autoscale.py``, pure and deterministic).  One
+tick = read capacity from a pluggable provider → scrape the trainer's
+``/metrics`` for health (goodput ratio, badput buckets, preemption
+counters) → one ``decide()`` → actuate.  Every transition goes
+through the forced-checkpoint path the resilience layer already
+proves: SIGTERM → the trainer checkpoints at the next step boundary
+and exits ``RESILIENCE.PREEMPT_EXIT_CODE`` (77) → relaunch at the
+decided topology → elastic resume reshards the restore.  The operator
+never kills a trainer any other way.
+
+Two actuation modes:
+
+- ``--mode local`` — the operator owns a ``python -m eksml_tpu.train``
+  child: SIGTERM / wait / relaunch with the target topology's
+  ``--config`` overrides (and, under ``--fake-chips``, the XLA
+  host-platform device-count flag — the chaos rig's topology knob).
+  This is the ``proc-capacity-wave`` chaos rung's subject and the
+  single-box dev loop.
+- ``--mode kubectl`` — in-cluster sidecar/CronJob: the transition is a
+  JobSet annotation patch (recording the decided topology) plus a
+  graceful pod deletion; kubelet delivers the SIGTERM, the chart's
+  podFailurePolicy maps exit 77 to restart-not-fail, and the relaunch
+  resumes elastically.  The serve fleet scales through
+  ``kubectl scale`` off the scraped ``eksml_serve_queue_depth`` — the
+  ACTIVE half of charts/serve's HPA for clusters without a
+  prometheus-adapter.
+
+Capacity providers: ``--capacity-file`` (JSON
+``{"available_chips": N, "preemption_forecast": 0.x}`` — the local
+stub and the chaos rung's wave driver), ``--capacity-env``
+(``EKSML_AVAILABLE_CHIPS``), or kubectl (sums the TPU-allocatable of
+Ready nodes).  A torn/missing signal is a recorded hold, never a
+crash.
+
+Evidence trail (the goodput ledger's downtime buckets show what the
+operator saved versus waiting dead):
+
+- flight events ``scale_launch`` / ``scale_decision`` /
+  ``scale_hold`` / ``scale_relaunch`` → ``<logdir>/events-hostop.jsonl``
+  (merged into run_report's timeline next to the trainer's own);
+- ``eksml_autoscale_*`` counters/gauges on the operator's own
+  ``/metrics`` (port 0 → ``<logdir>/telemetry-operator.port``),
+  preregistered at start so a healthy first scrape shows 0s;
+- every decision banked to ``<logdir>/autoscale-host<i>.jsonl`` —
+  ``tools/run_report.py``'s "Autoscaling" section joins it against
+  the goodput ledger.
+
+Usage::
+
+    python tools/eksml_operator.py --logdir /efs/train_log/run1 \\
+        --mode kubectl --jobset maskrcnn --namespace kubeflow \\
+        --config RESILIENCE.AUTOSCALE.CHIP_OPTIONS="(16,32)"
+    python tools/eksml_operator.py --logdir /tmp/run --mode local \\
+        --capacity-file /tmp/capacity.json --fake-chips \\
+        --global-batch 8 --train-config TRAIN.SHARDING.STRATEGY=fsdp
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eksml_tpu.config import (RESILIENCE_AUTOSCALE_DEFAULTS,  # noqa: E402
+                              SHARDING_DEFAULTS, config,
+                              knobs_with_defaults)
+from eksml_tpu.resilience.autoscale import (ACTIONS,  # noqa: E402
+                                            CapacitySignal,
+                                            HealthSignal, PolicyParams,
+                                            PolicyState, ScaleDecision,
+                                            Topology, decide,
+                                            serve_replicas,
+                                            topology_ladder)
+from eksml_tpu.telemetry.exporter import TelemetryExporter  # noqa: E402
+from eksml_tpu.telemetry.recorder import FlightRecorder  # noqa: E402
+from eksml_tpu.telemetry.registry import MetricRegistry  # noqa: E402
+
+log = logging.getLogger("eksml_operator")
+
+# the operator's flight events land in their own per-"host" file —
+# run_report merges every events-host*.jsonl by time, while the
+# goodput ledger keeps reading the trainer's events-host0.jsonl
+# unpolluted (two processes never append to one file)
+OPERATOR_HOST = "op"
+
+
+# ---------------------------------------------------------------------
+# capacity providers (pluggable; every failure degrades to None)
+# ---------------------------------------------------------------------
+
+
+class FileCapacityProvider:
+    """JSON file stub: the local/dev signal and the chaos rung's wave
+    driver.  ``{"available_chips": 8, "preemption_forecast": 0.1}``."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def read(self) -> Optional[CapacitySignal]:
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            return CapacitySignal(
+                int(doc["available_chips"]),
+                float(doc.get("preemption_forecast", 0.0)))
+        except (OSError, ValueError, TypeError, KeyError):
+            return None  # torn mid-rewrite or absent: a recorded hold
+
+
+class EnvCapacityProvider:
+    """``EKSML_AVAILABLE_CHIPS`` / ``EKSML_PREEMPTION_FORECAST``."""
+
+    def __init__(self, var: str = "EKSML_AVAILABLE_CHIPS",
+                 forecast_var: str = "EKSML_PREEMPTION_FORECAST"):
+        self.var, self.forecast_var = var, forecast_var
+
+    def read(self) -> Optional[CapacitySignal]:
+        raw = os.environ.get(self.var)
+        if raw is None:
+            return None
+        try:
+            return CapacitySignal(
+                int(raw),
+                float(os.environ.get(self.forecast_var, "0") or 0))
+        except ValueError:
+            return None
+
+
+class KubectlCapacityProvider:
+    """Sum the TPU-allocatable of Ready nodes (optionally filtered by
+    a label selector) — the in-cluster signal.  No forecast: node
+    pools don't publish one; wire a file provider next to it when the
+    capacity market does."""
+
+    def __init__(self, resource: str = "google.com/tpu",
+                 selector: str = "", kubectl: str = "kubectl",
+                 timeout: float = 30.0):
+        self.resource = resource
+        self.selector = selector
+        self.kubectl = kubectl
+        self.timeout = timeout
+
+    def command(self) -> List[str]:
+        cmd = [self.kubectl, "get", "nodes", "-o", "json"]
+        if self.selector:
+            cmd += ["-l", self.selector]
+        return cmd
+
+    @staticmethod
+    def _node_ready(node: Dict) -> bool:
+        for cond in node.get("status", {}).get("conditions", []):
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return False
+
+    def parse(self, doc: Dict) -> Optional[CapacitySignal]:
+        total = 0
+        for node in doc.get("items", []):
+            if not self._node_ready(node):
+                continue
+            alloc = node.get("status", {}).get("allocatable", {})
+            try:
+                total += int(alloc.get(self.resource, 0))
+            except (TypeError, ValueError):
+                continue
+        return CapacitySignal(total)
+
+    def read(self) -> Optional[CapacitySignal]:
+        try:
+            out = subprocess.run(
+                self.command(), capture_output=True, text=True,
+                timeout=self.timeout, check=False)
+            if out.returncode != 0:
+                return None
+            return self.parse(json.loads(out.stdout))
+        except (OSError, subprocess.TimeoutExpired,
+                json.JSONDecodeError):
+            return None
+
+
+# ---------------------------------------------------------------------
+# /metrics scrape → HealthSignal
+# ---------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_openmetrics(text: str
+                      ) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Exposition text → ``{name: [(labels, value), ...]}`` — just
+    enough parser for the operator's own scrapes (the exporter's
+    output is the strict side of this contract)."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels_raw, value_raw = m.groups()
+        try:
+            value = float(value_raw)
+        except ValueError:
+            continue
+        labels = {k: v for k, v in _LABEL_RE.findall(labels_raw or "")}
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def health_from_metrics(
+        families: Dict[str, List[Tuple[Dict[str, str], float]]]
+) -> HealthSignal:
+    """The exporter series the policy consumes, tolerant of partial
+    exposition (an old trainer without the goodput ledger scrapes to
+    an all-defaults signal)."""
+    ratio = None
+    for _labels, v in families.get("eksml_goodput_ratio", []):
+        ratio = v
+    badput = {labels.get("bucket", ""): v for labels, v in
+              families.get("eksml_badput_seconds_total", [])}
+    preempt = sum(v for _l, v in families.get(
+        "eksml_resilience_preemptions_total", []))
+    straggler = 0.0
+    for name, samples in families.items():
+        if name.startswith("eksml_hosts_") and name.endswith(
+                "_straggler"):
+            straggler = max([straggler] + [v for _l, v in samples])
+    return HealthSignal(goodput_ratio=ratio, badput_s=badput,
+                        preemptions=preempt, stragglers=straggler)
+
+
+def scrape_url(url: str, timeout: float = 5.0) -> Optional[str]:
+    import urllib.request
+
+    try:
+        return urllib.request.urlopen(
+            url, timeout=timeout).read().decode()
+    except (OSError, ValueError):
+        return None
+
+
+def trainer_metrics_url(logdir: str, host: int = 0) -> Optional[str]:
+    """The trainer's ephemeral-port discovery contract
+    (TELEMETRY.PORT=0 → ``telemetry-host<i>.port``).  A stale file
+    from the previous segment scrapes to a connection error, which
+    degrades to an unknown HealthSignal — correct mid-relaunch."""
+    path = os.path.join(logdir, f"telemetry-host{host}.port")
+    try:
+        with open(path) as f:
+            return f"http://127.0.0.1:{int(f.read().strip())}/metrics"
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------
+# actuators
+# ---------------------------------------------------------------------
+
+
+class LocalTrainerActuator:
+    """Owns one ``python -m eksml_tpu.train`` child: the single-box
+    actuation path (and the chaos rung's).  Child stdout goes to a
+    FILE (an undrained pipe deadlocks the child mid-compile — the
+    chaos-ladder lesson)."""
+
+    def __init__(self, logdir: str, train_config: Sequence[str],
+                 global_batch: int = 0, fake_chips: bool = False,
+                 synthetic: bool = False,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.logdir = logdir
+        self.train_config = list(train_config)
+        self.global_batch = int(global_batch)
+        self.fake_chips = fake_chips
+        self.synthetic = synthetic
+        self.extra_env = dict(extra_env or {})
+        self.launches = 0
+        self._proc: Optional[subprocess.Popen] = None
+
+    def command(self, topology: Topology) -> List[str]:
+        cmd = [sys.executable, "-m", "eksml_tpu.train",
+               "--logdir", self.logdir]
+        if self.synthetic:
+            cmd.append("--synthetic")
+        cmd += ["--config"] + self.train_config + list(
+            topology.config_overrides(self.global_batch))
+        return cmd
+
+    def environment(self, topology: Topology) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        if self.fake_chips:
+            # substitute ONLY the device-count flag; other inherited
+            # XLA_FLAGS must reach the child unchanged or relaunches
+            # run under a different XLA config than the first segment
+            kept = [f for f in env.get("XLA_FLAGS", "").split()
+                    if "xla_force_host_platform_device_count" not in f]
+            kept.append("--xla_force_host_platform_device_count="
+                        f"{topology.chips}")
+            env["XLA_FLAGS"] = " ".join(kept)
+        return env
+
+    def launch(self, topology: Topology) -> str:
+        self.launches += 1
+        log_path = os.path.join(
+            self.logdir, f"operator-train-{self.launches}.log")
+        with open(log_path, "a") as logf:  # child inherits the fd
+            self._proc = subprocess.Popen(
+                self.command(topology),
+                env=self.environment(topology), stdout=logf,
+                stderr=subprocess.STDOUT, cwd=REPO)
+        return log_path
+
+    def poll(self) -> Optional[int]:
+        """Child exit code, or None while it runs (or before launch)."""
+        if self._proc is None:
+            return None
+        return self._proc.poll()
+
+    @property
+    def running(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def stop(self, budget: float = 600.0) -> Optional[int]:
+        """SIGTERM → wait: the forced-checkpoint path.  Escalates to
+        SIGKILL only past ``budget`` (the chart's
+        terminationGracePeriodSeconds analogue)."""
+        if self._proc is None:
+            return None
+        if self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGTERM)
+            try:
+                self._proc.wait(timeout=budget)
+            except subprocess.TimeoutExpired:
+                log.warning("trainer ignored SIGTERM for %.0fs — "
+                            "SIGKILL", budget)
+                self._proc.kill()
+        if self._proc.poll() is None:  # reap the SIGKILLed child
+            try:
+                self._proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+        rc = self._proc.poll()
+        self._proc = None
+        return rc
+
+
+def kubectl_transition_cmds(jobset: str, namespace: str,
+                            topology: Topology, global_batch: int = 0,
+                            kubectl: str = "kubectl") -> List[List[str]]:
+    """The in-cluster transition: annotate the JobSet with the decided
+    topology (the relaunch contract the chart's restart consumes),
+    then delete its pods GRACEFULLY — kubelet delivers SIGTERM inside
+    terminationGracePeriodSeconds, the trainer forces a checkpoint and
+    exits 77, and podFailurePolicy restarts the JobSet instead of
+    failing it."""
+    overrides = " ".join(topology.config_overrides(global_batch))
+    patch = json.dumps({"metadata": {"annotations": {
+        "eksml.dev/target-topology": topology.name,
+        "eksml.dev/target-chips": str(topology.chips),
+        "eksml.dev/target-config": overrides}}})
+    return [
+        [kubectl, "-n", namespace, "patch", "jobset", jobset,
+         "--type", "merge", "-p", patch],
+        [kubectl, "-n", namespace, "delete", "pod",
+         "-l", f"jobset.sigs.k8s.io/jobset-name={jobset}",
+         "--wait=false"],
+    ]
+
+
+def kubectl_serve_scale_cmd(deployment: str, namespace: str,
+                            replicas: int,
+                            kubectl: str = "kubectl") -> List[str]:
+    return [kubectl, "-n", namespace, "scale",
+            f"deployment/{deployment}", f"--replicas={int(replicas)}"]
+
+
+# ---------------------------------------------------------------------
+# the operator loop
+# ---------------------------------------------------------------------
+
+
+class _StopFlag:
+    """SIGTERM/SIGINT land here flag-only (signal-safety rule: a
+    handler runs between bytecodes on the interrupted thread — no
+    locks, no logging, no metric publishes)."""
+
+    def __init__(self):
+        self.stop = False
+
+    def __call__(self, signum, frame):
+        self.stop = True
+
+
+class Operator:
+    def __init__(self, args, knobs: Dict, ladder: Sequence[Topology],
+                 provider, registry: Optional[MetricRegistry] = None,
+                 actuator: Optional[LocalTrainerActuator] = None):
+        self.args = args
+        self.knobs = knobs
+        self.ladder = tuple(ladder)
+        self.provider = provider
+        self.actuator = actuator
+        self.params = PolicyParams(
+            cooldown_sec=float(knobs["COOLDOWN_SEC"]),
+            grow_patience=int(knobs["GROW_PATIENCE"]),
+            shrink_patience=int(knobs["SHRINK_PATIENCE"]),
+            forecast_hold=float(knobs["FORECAST_HOLD"]),
+            min_goodput_for_grow=float(knobs["MIN_GOODPUT_FOR_GROW"]))
+        self.state: Optional[PolicyState] = None
+        self.stop_flag = _StopFlag()
+        self.bank_path = os.path.join(
+            args.logdir, f"autoscale-host{args.operator_id}.jsonl")
+        self.bank_failures = 0
+        self.restarts = 0
+        self.serve_target: Optional[int] = None
+
+        self.registry = registry or MetricRegistry()
+        self._preregister(self.registry)
+        self.recorder = FlightRecorder(
+            capacity=256,
+            path=os.path.join(args.logdir,
+                              f"events-host{OPERATOR_HOST}.jsonl"),
+            host_id=OPERATOR_HOST)
+        self.exporter = TelemetryExporter(
+            port=args.port, registry=self.registry,
+            port_file=os.path.join(args.logdir,
+                                   "telemetry-operator.port"))
+
+    # -- satellite 1: the PR-4 preregistration convention -------------
+    @staticmethod
+    def _preregister(registry: MetricRegistry) -> None:
+        """Create every eksml_autoscale_* series at operator start so
+        a healthy first scrape shows the whole family at 0."""
+        for action in ACTIONS:
+            registry.counter(
+                "eksml_autoscale_decisions",
+                "scale decisions by action", labels={"action": action})
+        registry.gauge(
+            "eksml_autoscale_target_chips",
+            "chip count of the currently-decided topology")
+        registry.gauge(
+            "eksml_autoscale_available_chips",
+            "capacity provider's latest available-chip reading")
+        registry.counter(
+            "eksml_autoscale_relaunches",
+            "trainer relaunches driven through the forced-checkpoint "
+            "path")
+        registry.counter(
+            "eksml_autoscale_capacity_errors",
+            "ticks whose capacity signal was unreadable")
+        registry.gauge(
+            "eksml_autoscale_serve_target_replicas",
+            "desired serve replicas (the active half of the serve "
+            "HPA)")
+
+    # -- evidence trail ------------------------------------------------
+    def _bank(self, row: Dict) -> None:
+        row = dict(row)
+        row.setdefault("time", time.time())
+        try:
+            with open(self.bank_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except (OSError, TypeError, ValueError):
+            self.bank_failures += 1
+
+    def _record_decision(self, decision: ScaleDecision,
+                         capacity: Optional[CapacitySignal],
+                         health: HealthSignal) -> None:
+        self.registry.counter(
+            "eksml_autoscale_decisions", "",
+            labels={"action": decision.action}).inc()
+        self.registry.gauge("eksml_autoscale_target_chips",
+                            "").set(decision.target.chips)
+        if capacity is not None:
+            self.registry.gauge("eksml_autoscale_available_chips",
+                                "").set(capacity.available_chips)
+        row = decision.to_dict()
+        row["kind"] = "decision"
+        if capacity is not None:
+            row["available_chips"] = capacity.available_chips
+            row["preemption_forecast"] = capacity.preemption_forecast
+        if health.goodput_ratio is not None:
+            row["goodput_ratio"] = round(health.goodput_ratio, 4)
+        self._bank(row)
+        event_kind = ("scale_hold" if decision.action == "hold"
+                      else "scale_decision")
+        self.recorder.record(event_kind, action=decision.action,
+                             target=decision.target.name,
+                             target_chips=decision.target.chips,
+                             reason=decision.reason)
+
+    # -- health --------------------------------------------------------
+    def _scrape_health(self) -> HealthSignal:
+        url = trainer_metrics_url(self.args.logdir)
+        text = scrape_url(url) if url else None
+        if text is None:
+            return HealthSignal()
+        return health_from_metrics(parse_openmetrics(text))
+
+    # -- actuation -----------------------------------------------------
+    def _actuate(self, decision: ScaleDecision) -> None:
+        target = decision.target
+        if self.args.mode == "local":
+            assert self.actuator is not None
+            t0 = time.time()
+            rc = self.actuator.stop(budget=self.args.stop_budget)
+            stopped_t = time.time()
+            self.actuator.launch(target)
+            self.registry.counter("eksml_autoscale_relaunches",
+                                  "").inc()
+            self.recorder.record(
+                "scale_relaunch", action=decision.action,
+                target=target.name, target_chips=target.chips,
+                exit_code=rc,
+                relaunch_gap_s=round(time.time() - stopped_t, 3))
+            self._bank({"kind": "relaunch", "action": decision.action,
+                        "target": target.name,
+                        "target_chips": target.chips, "exit_code": rc,
+                        "stop_s": round(stopped_t - t0, 3),
+                        "relaunch_gap_s":
+                            round(time.time() - stopped_t, 3)})
+            return
+        # kubectl mode: the graceful-deletion transition
+        cmds = kubectl_transition_cmds(
+            self.args.jobset, self.args.namespace, target,
+            self.args.global_batch, kubectl=self.args.kubectl)
+        rcs = [self._run_kubectl(c) for c in cmds]
+        self.registry.counter("eksml_autoscale_relaunches", "").inc()
+        self.recorder.record("scale_relaunch", action=decision.action,
+                             target=target.name,
+                             target_chips=target.chips,
+                             kubectl_rcs=rcs)
+        self._bank({"kind": "relaunch", "action": decision.action,
+                    "target": target.name,
+                    "target_chips": target.chips,
+                    "kubectl_rcs": rcs})
+
+    def _run_kubectl(self, cmd: List[str]) -> int:
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=self.args.kubectl_timeout,
+                                 check=False)
+            if out.returncode != 0:
+                log.warning("kubectl failed (%d): %s\n%s",
+                            out.returncode, " ".join(cmd),
+                            out.stderr[-500:])
+            return out.returncode
+        except (OSError, subprocess.TimeoutExpired) as e:
+            log.warning("kubectl errored: %s (%s)", " ".join(cmd), e)
+            return -1
+
+    # -- serve fleet (active half of the charts/serve HPA) ------------
+    def _scale_serve(self) -> None:
+        target_depth = float(self.knobs["SERVE_TARGET_QUEUE_DEPTH"])
+        if target_depth <= 0 or not self.args.serve_metrics_url:
+            return
+        text = scrape_url(self.args.serve_metrics_url)
+        if text is None:
+            return
+        fams = parse_openmetrics(text)
+        depths = [v for _l, v in fams.get("eksml_serve_queue_depth",
+                                          [])]
+        if not depths:
+            return
+        depth = sum(depths) / len(depths)
+        current = (self.serve_target
+                   or int(self.knobs["SERVE_MIN_REPLICAS"]))
+        desired = serve_replicas(
+            depth, current, target_depth,
+            int(self.knobs["SERVE_MIN_REPLICAS"]),
+            int(self.knobs["SERVE_MAX_REPLICAS"]))
+        self.registry.gauge("eksml_autoscale_serve_target_replicas",
+                            "").set(desired)
+        if desired == self.serve_target:
+            return
+        self.serve_target = desired
+        self.recorder.record("scale_serve", replicas=desired,
+                             queue_depth=round(depth, 2))
+        self._bank({"kind": "serve_scale", "replicas": desired,
+                    "queue_depth": round(depth, 2)})
+        if self.args.mode == "kubectl" and self.args.serve_deployment:
+            self._run_kubectl(kubectl_serve_scale_cmd(
+                self.args.serve_deployment, self.args.namespace,
+                desired, kubectl=self.args.kubectl))
+
+    # -- lifecycle -----------------------------------------------------
+    def _initial_topology(self,
+                          capacity: Optional[CapacitySignal]
+                          ) -> Topology:
+        if self.args.initial_chips:
+            for topo in self.ladder:
+                if topo.chips == self.args.initial_chips:
+                    return topo
+            raise SystemExit(
+                f"--initial-chips {self.args.initial_chips} names no "
+                f"ladder rung (have "
+                f"{[t.chips for t in self.ladder]})")
+        if capacity is not None:
+            for topo in reversed(self.ladder):
+                if topo.chips <= capacity.available_chips:
+                    return topo
+        return self.ladder[-1]
+
+    def start(self) -> None:
+        self.exporter.start()
+        capacity = self.provider.read()
+        topo = self._initial_topology(capacity)
+        now = time.time()
+        self.state = PolicyState(topo, last_change_t=now)
+        self.registry.gauge("eksml_autoscale_target_chips",
+                            "").set(topo.chips)
+        if self.args.mode == "local" and self.actuator is not None:
+            log_path = self.actuator.launch(topo)
+            log.info("launched trainer at %s (%d chips) → %s",
+                     topo.name, topo.chips, log_path)
+        self.recorder.record("scale_launch", target=topo.name,
+                             target_chips=topo.chips)
+        self._bank({"kind": "launch", "target": topo.name,
+                    "target_chips": topo.chips})
+
+    def _child_watch(self) -> bool:
+        """Local-mode child supervision between decisions.  Returns
+        False when the operator should exit (training completed or
+        the restart budget is spent)."""
+        if self.args.mode != "local" or self.actuator is None:
+            return True
+        rc = self.actuator.poll()
+        if rc is None:
+            return True
+        if rc == 0:
+            log.info("trainer completed (exit 0) — operator done")
+            self.recorder.record("train_complete", exit_code=0)
+            self._bank({"kind": "train_complete", "exit_code": 0})
+            return False
+        # a crash (or an externally-delivered preemption): relaunch at
+        # the CURRENT topology, bounded like JobSet maxRestarts
+        self.restarts += 1
+        if self.restarts > self.args.max_restarts:
+            log.error("trainer exit %d and restart budget (%d) spent",
+                      rc, self.args.max_restarts)
+            self._bank({"kind": "restart_budget_spent",
+                        "exit_code": rc})
+            return False
+        assert self.state is not None
+        topo = self.state.topology
+        self.actuator.launch(topo)
+        self.registry.counter("eksml_autoscale_relaunches", "").inc()
+        self.recorder.record("scale_relaunch", action="restart",
+                             target=topo.name,
+                             target_chips=topo.chips, exit_code=rc)
+        self._bank({"kind": "relaunch", "action": "restart",
+                    "target": topo.name, "target_chips": topo.chips,
+                    "exit_code": rc})
+        return True
+
+    def tick(self) -> None:
+        now = time.time()
+        capacity = self.provider.read()
+        health = self._scrape_health()
+        if capacity is None:
+            self.registry.counter("eksml_autoscale_capacity_errors",
+                                  "").inc()
+            assert self.state is not None
+            decision = ScaleDecision(
+                "hold", self.state.topology,
+                "capacity signal unavailable")
+            self._record_decision(decision, None, health)
+        else:
+            assert self.state is not None
+            decision, self.state = decide(
+                self.state, capacity, health, self.ladder,
+                self.params, now)
+            self._record_decision(decision, capacity, health)
+            if decision.action != "hold":
+                self._actuate(decision)
+        self._scale_serve()
+
+    def run(self) -> int:
+        self.start()
+        interval = float(self.args.interval
+                         or self.knobs["INTERVAL_SEC"])
+        ticks = 0
+        try:
+            while not self.stop_flag.stop:
+                if not self._child_watch():
+                    break
+                self.tick()
+                ticks += 1
+                if self.args.once or (self.args.max_ticks
+                                      and ticks >= self.args.max_ticks):
+                    break
+                deadline = time.time() + interval
+                while (time.time() < deadline
+                       and not self.stop_flag.stop):
+                    time.sleep(min(
+                        0.2, max(0.0, deadline - time.time())))
+        finally:
+            if self.args.mode == "local" and self.actuator is not None:
+                rc = self.actuator.stop(budget=self.args.stop_budget)
+                if rc is not None:
+                    self.recorder.record("scale_stop", exit_code=rc)
+                    self._bank({"kind": "stop", "exit_code": rc})
+            self.exporter.stop()
+        return 0
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--logdir", required=True,
+                   help="training run directory (evidence trail + "
+                        "local-mode trainer logdir)")
+    p.add_argument("--mode", choices=("local", "kubectl"),
+                   default="local")
+    p.add_argument("--config", nargs="*", default=[],
+                   help="config overrides, e.g. "
+                        "RESILIENCE.AUTOSCALE.COOLDOWN_SEC=120")
+    p.add_argument("--capacity-file", default=None,
+                   help="JSON capacity stub "
+                        '{"available_chips": N, ...}')
+    p.add_argument("--capacity-env", action="store_true",
+                   help="read capacity from EKSML_AVAILABLE_CHIPS")
+    p.add_argument("--capacity-selector", default="",
+                   help="kubectl node label selector for the "
+                        "capacity census")
+    p.add_argument("--capacity-resource", default="google.com/tpu",
+                   help="allocatable resource counted as chips")
+    p.add_argument("--interval", type=float, default=0.0,
+                   help="tick seconds (0 = "
+                        "RESILIENCE.AUTOSCALE.INTERVAL_SEC)")
+    p.add_argument("--once", action="store_true",
+                   help="single tick then exit (CronJob mode)")
+    p.add_argument("--max-ticks", type=int, default=0,
+                   help="exit after N ticks (chaos harness bound; "
+                        "0 = run until signaled)")
+    p.add_argument("--port", type=int, default=0,
+                   help="operator /metrics port (0 = ephemeral, "
+                        "published to telemetry-operator.port)")
+    p.add_argument("--operator-id", type=int, default=0,
+                   help="suffix of autoscale-host<i>.jsonl")
+    # local mode
+    p.add_argument("--train-config", nargs="*", default=[],
+                   help="base --config items for the local trainer "
+                        "(topology overrides are appended)")
+    p.add_argument("--global-batch", type=int, default=0,
+                   help="hold chips x per-chip batch at this global "
+                        "batch across topologies (0 = leave batch "
+                        "knobs alone)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="pass --synthetic to the local trainer")
+    p.add_argument("--fake-chips", action="store_true",
+                   help="drive topology via "
+                        "xla_force_host_platform_device_count "
+                        "(CPU chaos rig)")
+    p.add_argument("--initial-chips", type=int, default=0,
+                   help="ladder rung to launch at (0 = best fit of "
+                        "the first capacity reading)")
+    p.add_argument("--stop-budget", type=float, default=600.0,
+                   help="seconds a SIGTERMed trainer may take to "
+                        "checkpoint before SIGKILL")
+    p.add_argument("--max-restarts", type=int, default=10,
+                   help="local-mode crash-relaunch budget (the "
+                        "JobSet maxRestarts analogue)")
+    # kubectl mode
+    p.add_argument("--kubectl", default="kubectl")
+    p.add_argument("--kubectl-timeout", type=float, default=60.0)
+    p.add_argument("--jobset", default="maskrcnn")
+    p.add_argument("--namespace", default="kubeflow")
+    p.add_argument("--serve-deployment", default="",
+                   help="serve Deployment to scale (kubectl mode)")
+    p.add_argument("--serve-metrics-url", default="",
+                   help="a serve pod's /metrics URL (queue-depth "
+                        "source for the active HPA half)")
+    return p
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    args = build_parser().parse_args(argv)
+    os.makedirs(args.logdir, exist_ok=True)
+
+    # --train-config is applied too: the operator's ladder must read
+    # the SAME sharding strategy the trainer will run under
+    config.update_args(list(args.config) + list(args.train_config))
+    knobs = knobs_with_defaults(
+        getattr(getattr(config, "RESILIENCE", None), "AUTOSCALE",
+                None), RESILIENCE_AUTOSCALE_DEFAULTS)
+    sharding = knobs_with_defaults(
+        getattr(getattr(config, "TRAIN", None), "SHARDING", None),
+        SHARDING_DEFAULTS)
+    chip_options = tuple(
+        int(c) for c in (knobs["CHIP_OPTIONS"] or ()))
+    if not chip_options:
+        raise SystemExit(
+            "RESILIENCE.AUTOSCALE.CHIP_OPTIONS is empty — pass "
+            '--config RESILIENCE.AUTOSCALE.CHIP_OPTIONS="(4,8)" '
+            "(the ladder the operator may scale over)")
+    ladder = topology_ladder(
+        chip_options, strategy=str(sharding["STRATEGY"]),
+        model_axis=int(sharding["MODEL_AXIS_SIZE"]),
+        num_slices=max(1, int(getattr(config.TPU, "NUM_SLICES", 1))))
+    if not ladder:
+        raise SystemExit(
+            f"no valid topology for CHIP_OPTIONS={chip_options} "
+            f"under strategy {sharding['STRATEGY']!r} — every count "
+            "was rejected by the plan_mesh divisibility contract")
+
+    if args.capacity_file:
+        provider = FileCapacityProvider(args.capacity_file)
+    elif args.capacity_env:
+        provider = EnvCapacityProvider()
+    elif args.mode == "kubectl":
+        provider = KubectlCapacityProvider(
+            resource=args.capacity_resource,
+            selector=args.capacity_selector, kubectl=args.kubectl,
+            timeout=args.kubectl_timeout)
+    else:
+        raise SystemExit("local mode needs --capacity-file or "
+                         "--capacity-env")
+
+    actuator = None
+    if args.mode == "local":
+        actuator = LocalTrainerActuator(
+            args.logdir, args.train_config,
+            global_batch=args.global_batch,
+            fake_chips=args.fake_chips, synthetic=args.synthetic)
+
+    op = Operator(args, knobs, ladder, provider, actuator=actuator)
+    signal.signal(signal.SIGTERM, op.stop_flag)
+    signal.signal(signal.SIGINT, op.stop_flag)
+    log.info("operator up: ladder=%s interval=%ss mode=%s",
+             [t.name for t in ladder],
+             args.interval or knobs["INTERVAL_SEC"], args.mode)
+    return op.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

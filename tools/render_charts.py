@@ -44,6 +44,7 @@ CHART_SPECS = {
                                   "subcharts": ("tensorboard",
                                                 "jupyter")},
     "charts/serve": {"main": "serve", "subcharts": ()},
+    "charts/autoscaler": {"main": "autoscaler", "subcharts": ()},
 }
 CHARTS = tuple(CHART_SPECS)
 SUBCHARTS = ("tensorboard", "jupyter")
@@ -61,6 +62,8 @@ GOLDEN_VALUES = {
                          "eksml-viz:golden"},
     "serve": {"image": "REGION-docker.pkg.dev/PROJECT/eksml/"
                        "eksml-train:golden"},
+    "autoscaler": {"image": "REGION-docker.pkg.dev/PROJECT/eksml/"
+                            "eksml-train:golden"},
 }
 
 

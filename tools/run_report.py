@@ -379,6 +379,83 @@ def _goodput_section(logdir: str) -> List[str]:
     return lines
 
 
+def _autoscale_section(logdir: str) -> List[str]:
+    """The autoscaling operator's decision trail (ISSUE 16): every
+    ``decide()`` the operator banked to ``autoscale-host<i>.jsonl``,
+    with the transitions (and their trainer exit codes — 77 proves
+    the forced-checkpoint path) tabulated and joined against the
+    goodput ledger's between-relaunch downtime.  Degrades to a
+    pointer when no operator ran against this logdir."""
+    lines = ["## Autoscaling (operator decision trail)"]
+    rows: List[Dict] = []
+    for path in sorted(glob.glob(
+            os.path.join(logdir, "autoscale-host*.jsonl"))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn write from a killed operator
+        except OSError:
+            continue
+    if not rows:
+        lines += ["", "No autoscale-host*.jsonl found — no operator "
+                      "ran against this logdir.  "
+                      "(`python tools/eksml_operator.py --logdir "
+                      "<logdir> ...` banks every scale decision "
+                      "here; knobs under `RESILIENCE.AUTOSCALE`.)"]
+        return lines
+    rows.sort(key=lambda r: r.get("time", 0.0))
+    decisions = [r for r in rows if r.get("kind") == "decision"]
+    actions = {a: sum(1 for d in decisions if d.get("action") == a)
+               for a in ("hold", "grow", "shrink")}
+    relaunches = [r for r in rows if r.get("kind") == "relaunch"]
+    forced = sum(1 for r in relaunches if "exit_code" in r
+                 and r["exit_code"] == 77)
+    lines += [
+        "",
+        f"{len(decisions)} decision(s): {actions['hold']} hold, "
+        f"{actions['grow']} grow, {actions['shrink']} shrink; "
+        f"{len(relaunches)} relaunch(es), {forced} via the "
+        "forced-checkpoint path (trainer exit 77)."]
+    # the timeline keeps every transition but compresses the holds
+    # (steady state is one line of counts, not hundreds of rows)
+    shown = [r for r in rows if not (
+        r.get("kind") == "decision" and r.get("action") == "hold")]
+    if shown:
+        lines += ["", "| time | kind | action | target | chips | "
+                      "exit | detail |", "|---|---|---|---|---|---|"
+                                         "---|"]
+        for r in shown:
+            detail = r.get("reason", "")
+            if r.get("kind") == "relaunch" and "relaunch_gap_s" in r:
+                detail = f"relaunch gap {r['relaunch_gap_s']} s"
+            lines.append(
+                f"| {_ts(r.get('time'))} | {r.get('kind', '-')} "
+                f"| {r.get('action', '-')} | {r.get('target', '-')} "
+                f"| {r.get('target_chips', '-')} "
+                f"| {r.get('exit_code', '-')} | {detail} |")
+    # join against the goodput ledger: what the transitions cost
+    try:
+        from eksml_tpu.telemetry.goodput import build_ledger
+
+        ledger = build_ledger(logdir)
+        if ledger["segments"]:
+            down = ledger["downtime"]["total_s"]
+            lines += [
+                "",
+                f"The goodput ledger attributes "
+                f"{_fmt_num(down, 6)} s of between-relaunch downtime "
+                f"across {len(ledger['segments'])} segment(s) — the "
+                "operator's transitions are the bounded, "
+                "checkpointed alternative to dying at the old "
+                "topology (details in the Goodput section above)."]
+    except Exception as e:  # noqa: BLE001 — partial evidence is fine
+        lines += ["", f"(goodput join unavailable: {e!r})"]
+    return lines
+
+
 def _attribution_section(logdir: str,
                          attribution: Optional[str]) -> List[str]:
     path = attribution or os.path.join(logdir, "profile",
@@ -762,6 +839,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.extend(_elastic_section(events))
     lines.append("")
     lines.extend(_goodput_section(logdir))
+    lines.append("")
+    lines.extend(_autoscale_section(logdir))
     lines.append("")
     lines.extend(_slow_steps_section(logdir))
     lines.append("")
