@@ -358,6 +358,15 @@ TELEMETRY_GOODPUT_DEFAULTS = dict(
 #   eksml_serve_queue_depth with the same averageValue math and
 #   clamps to [SERVE_MIN_REPLICAS, SERVE_MAX_REPLICAS];
 #   SERVE_TARGET_QUEUE_DEPTH=0 disables serve scaling.
+# - CANARY_*: the promotion controller's SLO gate (the canary half of
+#   the serving continuous-deployment loop, tools/eksml_operator.py
+#   --promote): a shadow-scored canary checkpoint is rolled back when
+#   its replayed p99 exceeds CANARY_P99_RATIO_MAX x the incumbent's,
+#   its error rate exceeds CANARY_ERROR_RATE_MAX, or its
+#   detection-output drift exceeds CANARY_DRIFT_MAX; it is promoted
+#   only after CANARY_PROMOTE_STREAK consecutive in-SLO scores over at
+#   least CANARY_MIN_REQUESTS replayed requests each (rollback is
+#   immediate, promotion is patient — the rollout asymmetry).
 RESILIENCE_AUTOSCALE_DEFAULTS = dict(
     INTERVAL_SEC=30.0,
     COOLDOWN_SEC=300.0,
@@ -369,6 +378,11 @@ RESILIENCE_AUTOSCALE_DEFAULTS = dict(
     SERVE_TARGET_QUEUE_DEPTH=0.0,
     SERVE_MIN_REPLICAS=2,
     SERVE_MAX_REPLICAS=16,
+    CANARY_P99_RATIO_MAX=1.5,
+    CANARY_ERROR_RATE_MAX=0.02,
+    CANARY_DRIFT_MAX=0.25,
+    CANARY_MIN_REQUESTS=20,
+    CANARY_PROMOTE_STREAK=2,
 )
 
 # Online-serving knobs (eksml_tpu/serve/) — ONE source of truth, same
@@ -400,6 +414,17 @@ RESILIENCE_AUTOSCALE_DEFAULTS = dict(
 # - RESULT_MASKS: include RLE instance masks in /v1/predict responses
 #   by default (per-request `masks` field still overrides); mask
 #   pasting is host-side postprocess cost, so the default is off.
+# - RELOAD_POLL_SEC: the checkpoint hot-reload watcher's poll period
+#   over <checkpoint-dir>/checkpoints.  0 disables the watcher (the
+#   /admin/reload endpoint still works when a checkpoint dir was
+#   given).  Each candidate is verified against its integrity +
+#   topology manifests, restored OFF the request path, and swapped
+#   between micro-batches — in-flight requests finish on the old
+#   params and the AOT bucket cache is reused (zero request-path
+#   compiles across the swap).
+# - RELOAD_DIGEST: verify sha256 digests during reload validation when
+#   the manifest carries them (RESILIENCE.CHECKPOINT_DIGEST saves
+#   them); size-only checking is cheaper on huge checkpoints.
 SERVE_DEFAULTS = dict(
     PORT=8081,
     MAX_BATCH_SIZE=4,
@@ -408,6 +433,8 @@ SERVE_DEFAULTS = dict(
     BATCH_SIZES=(),
     BUCKETS=(),
     RESULT_MASKS=False,
+    RELOAD_POLL_SEC=0.0,
+    RELOAD_DIGEST=True,
 )
 
 

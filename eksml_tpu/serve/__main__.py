@@ -36,9 +36,11 @@ import threading
 log = logging.getLogger("eksml_tpu.serve")
 
 
-def _random_params(cfg, model, buckets):
+def _random_params(cfg, model, buckets, seed: int = 0):
     """Initialize params from the PRNG at the smallest bucket — the
-    hermetic smoke/load-test path (no checkpoint required)."""
+    hermetic smoke/load-test path (no checkpoint required).  ``seed``
+    gives tests a SECOND distinct tree of identical structure (the
+    swap-parity tests need two checkpoints' worth of params)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -50,7 +52,7 @@ def _random_params(cfg, model, buckets):
     hw = jnp.asarray([[bh, bw]], np.float32)
     init = jax.jit(lambda r: model.init(
         r, images, hw, method=type(model).predict))
-    return init(jax.random.PRNGKey(0))["params"]
+    return init(jax.random.PRNGKey(seed))["params"]
 
 
 def main(argv=None) -> int:
@@ -77,6 +79,11 @@ def main(argv=None) -> int:
                         "device_infer/postprocess) here as Chrome-"
                         "trace JSON at drain; requires "
                         "TELEMETRY.TRACING.ENABLED=True")
+    p.add_argument("--serve-id", default="serve",
+                   help="instance id: names the flight-event file "
+                        "(events-host<id>.jsonl) so stable and canary "
+                        "pods sharing a logdir do not clobber each "
+                        "other's reload timeline")
     p.add_argument("--config", nargs="*", default=[],
                    metavar="KEY=VALUE",
                    help="dotted config overrides (the chart-rendered "
@@ -89,10 +96,12 @@ def main(argv=None) -> int:
     if not args.random_params and not args.checkpoint_dir:
         p.error("need --checkpoint-dir or --random-params")
 
+    from eksml_tpu import telemetry
     from eksml_tpu.config import config, finalize_configs
     from eksml_tpu.models import MaskRCNN
     from eksml_tpu.serve.batcher import MicroBatcher
     from eksml_tpu.serve.engine import InferenceEngine, bucket_schedule
+    from eksml_tpu.serve.reload import ReloadManager
     from eksml_tpu.serve.server import ServingServer
     from eksml_tpu.utils.compile_cache import enable_persistent_cache
 
@@ -126,6 +135,24 @@ def main(argv=None) -> int:
         batcher, port=port, addr=args.addr, port_file=args.port_file,
         result_masks_default=bool(cfg.SERVE.RESULT_MASKS))
 
+    reload_mgr = None
+    if args.checkpoint_dir:
+        # reload/rollout flight events land next to the training
+        # ones (events-host<serve_id>.jsonl in the logdir) so
+        # run_report's Deployments section reads one merged timeline
+        telemetry.install(telemetry.FlightRecorder(
+            capacity=256,
+            path=telemetry.events_path_for(args.checkpoint_dir,
+                                           args.serve_id),
+            host_id=args.serve_id))
+        reload_mgr = ReloadManager(
+            engine, args.checkpoint_dir,
+            lock=server.lifecycle_lock,
+            poll_sec=float(cfg.SERVE.RELOAD_POLL_SEC),
+            is_draining=server.draining.is_set,
+            check_digest=bool(cfg.SERVE.RELOAD_DIGEST))
+        server.reload_manager = reload_mgr
+
     # SIGTERM/SIGINT → drain.  Handler only sets an Event (the
     # preemption-layer discipline: no locks, no I/O in signal context).
     stop = threading.Event()
@@ -139,12 +166,19 @@ def main(argv=None) -> int:
     server.start()
     n = engine.warmup()
     server.mark_ready()
+    if reload_mgr is not None:
+        # watcher starts AFTER warmup: the executables it relies on
+        # for a zero-compile swap must already exist
+        reload_mgr.start()
     log.info("ready: %d warm executable(s) over %d bucket(s) x %s "
-             "batch rung(s) on port %d", n, len(engine.buckets),
-             engine.rungs, server.port)
+             "batch rung(s) on port %d (params step %s)",
+             n, len(engine.buckets), engine.rungs, server.port,
+             engine.params_step)
     stop.wait()
     log.info("signal received: draining")
     server.drain()
+    if reload_mgr is not None:
+        reload_mgr.stop()
     if tracer is not None and args.trace_file:
         tracer.flush()
     return 0

@@ -65,11 +65,12 @@ class _Request:
     :meth:`wait_result`."""
 
     __slots__ = ("canvas", "scale", "nh", "nw", "bucket", "orig_hw",
-                 "score_thresh", "want_masks", "t_enqueue", "timings_ms",
-                 "batch_fill", "batch_rung", "_done", "_result", "_error")
+                 "score_thresh", "want_masks", "raw_topk", "t_enqueue",
+                 "timings_ms", "batch_fill", "batch_rung", "served_step",
+                 "raw_top", "_done", "_result", "_error")
 
     def __init__(self, canvas, scale, nh, nw, bucket, orig_hw,
-                 score_thresh, want_masks, pad_ms):
+                 score_thresh, want_masks, pad_ms, raw_topk=0):
         self.canvas = canvas
         self.scale = scale
         self.nh, self.nw = nh, nw
@@ -77,10 +78,13 @@ class _Request:
         self.orig_hw = orig_hw
         self.score_thresh = score_thresh
         self.want_masks = want_masks
+        self.raw_topk = raw_topk
         self.t_enqueue = time.perf_counter()
         self.timings_ms: Dict[str, float] = {"pad": round(pad_ms, 3)}
         self.batch_fill = 0
         self.batch_rung = 0
+        self.served_step: Optional[int] = None  # checkpoint that served
+        self.raw_top = None                     # pre-threshold top-k
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -168,7 +172,8 @@ class MicroBatcher:
 
     def submit(self, image: np.ndarray,
                score_thresh: Optional[float] = None,
-               want_masks: bool = False) -> _Request:
+               want_masks: bool = False,
+               raw_topk: int = 0) -> _Request:
         """Preprocess + enqueue; returns the request handle.  Raises
         :class:`DrainingError` / :class:`QueueFullError` on rejection
         (mapped to 503 / 429 by the server)."""
@@ -191,7 +196,8 @@ class MicroBatcher:
         telemetry.complete_span("pad", t0, t1, bucket=bucket)
         req = _Request(canvas, scale, nh, nw, bucket,
                        image.shape[:2], score_thresh, want_masks,
-                       pad_ms=(t1 - t0) * 1e3)
+                       pad_ms=(t1 - t0) * 1e3,
+                       raw_topk=max(0, int(raw_topk)))
         # drain re-check + enqueue are ATOMIC vs close(): close() sets
         # _draining and enqueues the STOP sentinel under this same
         # lock, so a request either lands in the queue AHEAD of STOP
@@ -269,7 +275,13 @@ class MicroBatcher:
         try:
             images = np.stack([r.canvas for r in batch])
             hw = np.asarray([[r.nh, r.nw] for r in batch], np.float32)
-            out = self.engine.infer(images, hw, batch[0].bucket)
+            # ONE consistent (params, step) snapshot per micro-batch:
+            # a hot-reload landing mid-batch cannot split the batch
+            # across checkpoints, and every response names the
+            # checkpoint that actually served it
+            params, params_step = self.engine.params_snapshot()
+            out = self.engine.infer(images, hw, batch[0].bucket,
+                                    params=params)
             t_d1 = time.perf_counter()
             infer_ms = (t_d1 - t_d0) * 1e3
             telemetry.complete_span("device_infer", t_d0, t_d1,
@@ -288,6 +300,23 @@ class MicroBatcher:
                 dets = detections_from_raw(
                     {k: v[i] for k, v in out.items()}, r.scale, h, w,
                     thresh, want_masks=r.want_masks)
+                if r.raw_topk:
+                    # pre-threshold top-k raw head outputs: the shadow
+                    # scorer's drift signal — differs whenever the
+                    # params differ, even when both checkpoints emit
+                    # zero above-threshold detections
+                    k_top = min(r.raw_topk, out["scores"].shape[1])
+                    order = np.argsort(-out["scores"][i],
+                                       kind="stable")[:k_top]
+                    r.raw_top = {
+                        "scores": [float(s) for s in
+                                   out["scores"][i][order]],
+                        "classes": [int(c) for c in
+                                    out["classes"][i][order]],
+                        "boxes": [[float(x) for x in bx] for bx in
+                                  out["boxes"][i][order]],
+                    }
+                r.served_step = params_step
                 t_p1 = time.perf_counter()
                 telemetry.complete_span("postprocess", t_p0, t_p1)
                 r.timings_ms["device_infer"] = round(infer_ms, 3)

@@ -96,11 +96,22 @@ class InferenceEngine:
             if not checkpoint_dir:
                 raise ValueError("need params or checkpoint_dir")
             from eksml_tpu.predict.predictor import restore_predict_params
+            from eksml_tpu.utils import CheckpointManager
 
+            if checkpoint_step is None:
+                # resolve "latest" NOW so params_step names the actual
+                # step (the reload watcher compares candidates to it)
+                checkpoint_step = CheckpointManager(
+                    checkpoint_dir).latest_step()
             params = restore_predict_params(cfg, self.model,
                                             checkpoint_dir,
                                             checkpoint_step)
         self.params = params
+        # checkpoint step of the serving params (None = handed in
+        # directly, e.g. --random-params); swap_params moves it
+        self.params_step: Optional[int] = (
+            int(checkpoint_step) if checkpoint_step is not None
+            else None)
         self.buckets = bucket_schedule(cfg)
         self.rungs = batch_rungs(cfg)
         self.max_batch = self.rungs[-1]
@@ -133,6 +144,55 @@ class InferenceEngine:
             "eksml_serve_warm_executables",
             "predict executables currently compiled")
         self._m_warm.set_function(lambda: len(self._exes))
+
+    # -- hot-reload (serve/reload.py drives these) ---------------------
+
+    def params_snapshot(self) -> Tuple[object, Optional[int]]:
+        """Consistent ``(params, step)`` pair for one micro-batch —
+        the dispatcher snapshots ONCE per batch so a concurrent
+        ``swap_params`` never splits a batch across checkpoints."""
+        with self._lock:
+            return self.params, self.params_step
+
+    def swap_params(self, new_params, step: Optional[int] = None
+                    ) -> None:
+        """Replace the serving params with a restored checkpoint tree.
+
+        The warm executables were lowered against ``self.params``'s
+        avals, so the replacement must match tree structure and every
+        leaf's shape/dtype — otherwise dispatching it would retrace
+        (or worse, silently donate wrong layouts).  Raises ValueError
+        on any mismatch, leaving the old params serving; the caller
+        (``ReloadManager``) turns that into a ``structure``
+        rejection.  The swap is a reference assignment under the
+        engine lock: in-flight batches hold their own snapshot and
+        finish on the old tree, and no executable is invalidated —
+        zero request-path compiles across the swap."""
+        import jax
+
+        old_td = jax.tree.structure(self.params)
+        new_td = jax.tree.structure(new_params)
+        if old_td != new_td:
+            raise ValueError(
+                f"params tree structure changed: {new_td} != {old_td} "
+                "— warm executables would not accept this checkpoint")
+        for (kp, old_leaf), new_leaf in zip(
+                jax.tree_util.tree_leaves_with_path(self.params),
+                jax.tree.leaves(new_params)):
+            kp = jax.tree_util.keystr(kp)
+            o_shape = tuple(getattr(old_leaf, "shape", ()))
+            n_shape = tuple(getattr(new_leaf, "shape", ()))
+            o_dtype = getattr(old_leaf, "dtype", None)
+            n_dtype = getattr(new_leaf, "dtype", None)
+            if o_shape != n_shape or o_dtype != n_dtype:
+                raise ValueError(
+                    f"params leaf {kp} changed "
+                    f"{o_shape}/{o_dtype} -> {n_shape}/{n_dtype} — "
+                    "warm executables would not accept this "
+                    "checkpoint")
+        with self._lock:
+            self.params = new_params
+            self.params_step = int(step) if step is not None else None
 
     # -- preprocessing (the bucket contract) ---------------------------
 
@@ -236,8 +296,8 @@ class InferenceEngine:
     # -- dispatch ------------------------------------------------------
 
     def infer(self, images: np.ndarray, hw: np.ndarray,
-              bucket: int, rung: Optional[int] = None
-              ) -> Dict[str, np.ndarray]:
+              bucket: int, rung: Optional[int] = None,
+              params=None) -> Dict[str, np.ndarray]:
         """Dispatch ``n`` preprocessed canvases (``[n, H, W, 3]`` at
         the bucket's shape, ``hw [n, 2]`` content extents) through the
         (bucket, rung) executable, padding the batch dim up to the
@@ -245,12 +305,17 @@ class InferenceEngine:
         padding rows never leak into results.  ``rung`` pins a
         specific executable (the batch-vs-sequential bit-parity tests
         compare rows of ONE program); default is the smallest rung
-        that holds ``n``."""
+        that holds ``n``.  ``params`` pins an explicit tree (the
+        batcher passes its per-micro-batch snapshot so a hot-reload
+        mid-batch cannot split it); default is the current serving
+        params."""
         n = int(images.shape[0])
         if rung is None:
             rung = self.rung_for(n)
         elif n > rung:
             raise ValueError(f"batch of {n} does not fit rung {rung}")
+        if params is None:
+            params = self.params
         exe = self._executable(bucket, rung)
         if n < rung:
             pad_img = np.zeros((rung - n,) + images.shape[1:],
@@ -261,5 +326,5 @@ class InferenceEngine:
             pad_hw = np.ones((rung - n, 2), np.float32)
             hw = np.concatenate([hw.astype(np.float32), pad_hw],
                                 axis=0)
-        out = exe(self.params, images, hw.astype(np.float32))
+        out = exe(params, images, hw.astype(np.float32))
         return {k: np.asarray(v)[:n] for k, v in out.items()}

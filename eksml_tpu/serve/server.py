@@ -22,6 +22,9 @@ observability plane:
 - ``GET /metrics`` — the process registry as OpenMetrics, the
   ``eksml_serve_*`` family next to everything else; the charts/serve
   HPA scales on these series.
+- ``POST /admin/reload`` — verified checkpoint hot-reload on demand
+  (serve/reload.py): the promotion controller's demote/promote lever.
+  409 + reason on rejection, with the old params still serving.
 
 Drain (the PR 1 preemption discipline applied to serving): SIGTERM →
 stop admission (healthz + predict answer 503) → flush every accepted
@@ -131,6 +134,9 @@ class _Handler(BaseHTTPRequestHandler):
         # as a request line — a silent connection desync
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length)
+        if path == "/admin/reload":
+            self._admin_reload(body)
+            return
         if path != "/v1/predict":
             self._send_json(404, {"error": f"no route {path}"})
             return
@@ -146,6 +152,35 @@ class _Handler(BaseHTTPRequestHandler):
             self._predict(body)
         finally:
             s.note_http_done()
+
+    def _admin_reload(self, body: bytes) -> None:
+        """``POST /admin/reload`` — the promotion controller's lever:
+        verify + restore + swap a specific checkpoint step (JSON
+        ``{"step": N}``; empty body = latest candidate).  Runs the
+        restore in THIS handler thread — the dispatcher keeps serving
+        throughout; 409 answers a rejection with the reason (old
+        params still serving)."""
+        s = self.server_obj
+        mgr = s.reload_manager
+        if mgr is None:
+            self._send_json(503, {"error": "no reload manager: server "
+                                           "was started without a "
+                                           "checkpoint directory"})
+            return
+        step = None
+        if body:
+            try:
+                step = json.loads(body.decode("utf-8")).get("step")
+            except Exception as e:  # noqa: BLE001 — bad input is a 400
+                self._send_json(400, {"error": f"bad reload request: "
+                                               f"{e!r}"})
+                return
+        s.note_http_start()
+        try:
+            outcome = mgr.reload_step(step)
+        finally:
+            s.note_http_done()
+        self._send_json(200 if outcome.get("ok") else 409, outcome)
 
     def _predict(self, body: bytes) -> None:
         # error paths collect (code, payload) and answer OUTSIDE the
@@ -174,9 +209,11 @@ class _Handler(BaseHTTPRequestHandler):
             thresh = params.get("score_thresh")
             want_masks = bool(params.get(
                 "masks", s.result_masks_default))
+            raw_topk = int(params.get("raw_topk") or 0)
             try:
                 req = s.batcher.submit(image, score_thresh=thresh,
-                                       want_masks=want_masks)
+                                       want_masks=want_masks,
+                                       raw_topk=raw_topk)
             except QueueFullError as e:
                 fail = (429, {"error": str(e)})
             except DrainingError as e:
@@ -203,13 +240,19 @@ class _Handler(BaseHTTPRequestHandler):
                 row["mask_rle"] = rle
             out.append(row)
         bh, bw = s.batcher.engine.buckets[req.bucket]
-        self._send_json(200, {
+        resp = {
             "detections": out,
             "timings_ms": req.timings_ms,
             "bucket": [bh, bw],
             "batch_fill": req.batch_fill,
             "batch_rung": req.batch_rung,
-        })
+            # which checkpoint served this request — the hot-reload
+            # chaos rung proves the flip boundary from these
+            "params_step": req.served_step,
+        }
+        if req.raw_top is not None:
+            resp["raw_top"] = req.raw_top
+        self._send_json(200, resp)
 
     def log_message(self, fmt, *args):  # requests are not pod-log news
         log.debug("serve http: " + fmt, *args)
@@ -232,6 +275,14 @@ class ServingServer:
         self.result_masks_default = bool(result_masks_default)
         self.ready = threading.Event()     # warmup completed
         self.draining = threading.Event()  # SIGTERM seen / drain begun
+        # THE shared swap/drain lock: the SIGTERM drain flush and a
+        # hot-reload params swap both run under it, so they serialize
+        # — a reload can never swap params into a server that is
+        # mid-flush (reload.py re-checks `draining` under this lock)
+        self.lifecycle_lock = threading.Lock()
+        # ReloadManager, attached by __main__ when a checkpoint
+        # directory is being watched; None = /admin/reload answers 503
+        self.reload_manager = None
         self.started_monotonic = time.monotonic()
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
@@ -273,6 +324,11 @@ class ServingServer:
             "buckets": [list(b) for b in eng.buckets],
             "batch_rungs": list(eng.rungs),
             "devices": jax.device_count(),
+            "params_step": eng.params_step,
+            "reloads": (self.reload_manager.reloads
+                        if self.reload_manager else 0),
+            "reload_rejected": (self.reload_manager.rejected
+                                if self.reload_manager else 0),
         }
         return code, payload
 
@@ -319,7 +375,17 @@ class ServingServer:
         self.draining.set()
         log.info("drain: admission closed, flushing in-flight "
                  "requests")
-        self.batcher.close(drain=True, timeout=timeout)
+        # the flush holds the lifecycle lock: a hot-reload swap either
+        # completed BEFORE this (the flush serves the new params) or
+        # is rejected with reason "draining" when it re-checks under
+        # the lock — never interleaved with the flush.  `draining` is
+        # set first, so a reload that has not yet taken the lock bails
+        # early instead of queueing a pointless restore behind it.
+        # (batcher.close joins the dispatcher WITH a timeout — the
+        # bounded-blocking form the concurrency lint permits under a
+        # held lock)
+        with self.lifecycle_lock:
+            self.batcher.close(drain=True, timeout=timeout)
         # batched results are set; give handler threads a moment to
         # write their responses before the listener dies
         deadline = time.monotonic() + 10.0

@@ -1541,3 +1541,363 @@ def test_serve_drain_under_load(tmp_path, compile_cache):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+
+
+# ---- rungs: continuous-deployment serving fleet (ISSUE 17) -----------
+
+
+@pytest.fixture(scope="module")
+def trained_ckpts(tmp_path_factory, compile_cache):
+    """ONE tiny 6-step training run for both continuous-deployment
+    rungs: committed checkpoints at steps 2/4/6, each with its
+    integrity (and topology) manifest — the candidates the serving
+    fleet hot-reloads."""
+    logdir = str(tmp_path_factory.mktemp("cd_train"))
+    log_path = os.path.join(logdir, "train.log")
+    proc = _launch(logdir, compile_cache, log_path)
+    rc = proc.wait(timeout=900)
+    assert rc == 0, ("seed training run failed (rc=%s):\n%s"
+                     % (rc, open(log_path).read()[-3000:]))
+    assert _committed_ckpt_steps(logdir) == [2, 4, 6]
+    from eksml_tpu.resilience import integrity
+
+    root = os.path.join(logdir, "checkpoints")
+    for s in (2, 4, 6):
+        assert integrity.manifest_readable(root, s), s
+    return logdir
+
+
+def _publish_ckpt(src_logdir, dst_logdir, step, corrupt=False):
+    """Copy one committed step into a serving logdir the way training
+    publishes one: integrity/topology manifests FIRST, then the step
+    dir staged and renamed into its digit name — the reload watcher
+    only ever sees a committed dir whose evidence already exists.
+    ``corrupt=True`` truncates one payload file AFTER the manifest
+    copy (a kill mid-flush on NFS): size mismatch vs manifest."""
+    import shutil
+
+    src_root = os.path.join(src_logdir, "checkpoints")
+    dst_root = os.path.join(dst_logdir, "checkpoints")
+    integ = os.path.join(dst_root, ".integrity")
+    os.makedirs(integ, exist_ok=True)
+    for name in os.listdir(os.path.join(src_root, ".integrity")):
+        if name.startswith(f"{step}."):
+            shutil.copy2(os.path.join(src_root, ".integrity", name),
+                         os.path.join(integ, name))
+    staging = os.path.join(dst_root, f".staging-{step}")
+    shutil.copytree(os.path.join(src_root, str(step)), staging)
+    if corrupt:
+        biggest = max(
+            (os.path.join(dp, f) for dp, _, fs in os.walk(staging)
+             for f in fs),
+            key=os.path.getsize)
+        with open(biggest, "r+b") as f:
+            f.truncate(max(os.path.getsize(biggest) // 2, 1))
+    os.rename(staging, os.path.join(dst_root, str(step)))
+
+
+def _start_serve(ckpt_dir, port_file, log_path, cache_dir,
+                 serve_id="stable", step=None, extra_config=()):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "JAX_COMPILATION_CACHE_DIR": cache_dir})
+    cmd = [sys.executable, "-m", "eksml_tpu.serve",
+           "--checkpoint-dir", ckpt_dir, "--serve-id", serve_id,
+           "--port", "0", "--port-file", port_file,
+           "--addr", "127.0.0.1"]
+    if step is not None:
+        cmd += ["--step", str(step)]
+    cmd += ["--config"] + SERVE_TINY + list(extra_config)
+    with open(log_path, "w") as logf:
+        return subprocess.Popen(
+            cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+
+
+def _serve_url(proc, port_file, log_path, budget=900):
+    deadline = time.time() + budget
+    while not os.path.exists(port_file):
+        assert proc.poll() is None, (
+            "server died before binding:\n"
+            + open(log_path).read()[-3000:])
+        assert time.time() < deadline, "port file never appeared"
+        time.sleep(0.2)
+    return f"http://127.0.0.1:{open(port_file).read().strip()}"
+
+
+def _serve_events(logdir, serve_id):
+    path = os.path.join(logdir, f"events-host{serve_id}.jsonl")
+    events = []
+    if os.path.exists(path):
+        for line in open(path):
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return events
+
+
+@pytest.mark.slow
+def test_serve_hot_reload_under_load(tmp_path, compile_cache,
+                                     trained_ckpts):
+    """proc-serve-reload: a live server under open-loop load
+    hot-reloads a checkpoint published mid-run.  Contract (the
+    continuous-deployment half of the drain discipline): ZERO
+    dropped/errored requests, ZERO request-path compiles across the
+    swap, every response names the checkpoint that served it, and the
+    response stream flips 2 -> 4 exactly at the recorded
+    ``serve_reload`` boundary.  A corrupted-manifest candidate
+    (step 6) is REJECTED with the old params still serving."""
+    import threading
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_loadtest
+
+    serve_dir = str(tmp_path / "serve_log")
+    os.makedirs(os.path.join(serve_dir, "checkpoints"))
+    _publish_ckpt(trained_ckpts, serve_dir, 2)
+
+    port_file = str(tmp_path / "serve.port")
+    log_path = str(tmp_path / "serve.log")
+    proc = _start_serve(serve_dir, port_file, log_path, compile_cache,
+                        extra_config=["SERVE.RELOAD_POLL_SEC=0.25"])
+    try:
+        url = _serve_url(proc, port_file, log_path)
+        health = serve_loadtest.wait_ready(url, budget=900)
+        assert health["params_step"] == 2
+
+        result = {}
+
+        def load():
+            result["art"] = serve_loadtest.run_load(
+                url, requests=120, concurrency=4, mode="open",
+                rate=10.0, sizes="100x80,80x100,128x96",
+                timeout=120, keep_records=True)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+
+        # mid-run: publish step 4 the way training does; the watcher
+        # must verify + restore + swap while traffic flows
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            ok = serve_loadtest.metric_value(
+                serve_loadtest.scrape_metrics(url),
+                "eksml_serve_requests_total", '{outcome="ok"}')
+            if ok and ok >= 5:
+                break
+            time.sleep(0.1)
+        _publish_ckpt(trained_ckpts, serve_dir, 4)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            h = serve_loadtest.fetch_health(url)
+            if h.get("params_step") == 4:
+                break
+            time.sleep(0.2)
+        assert h.get("params_step") == 4, (
+            "hot-reload to step 4 never happened: %s\n%s"
+            % (h, open(log_path).read()[-3000:]))
+
+        # a corrupted candidate (step 6, payload truncated after its
+        # manifest landed) must be rejected — old params keep serving
+        _publish_ckpt(trained_ckpts, serve_dir, 6, corrupt=True)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            h = serve_loadtest.fetch_health(url)
+            if h.get("reload_rejected", 0) >= 1:
+                break
+            time.sleep(0.2)
+        assert h.get("reload_rejected", 0) >= 1, h
+        assert h.get("params_step") == 4, h
+
+        t.join(timeout=300)
+        assert not t.is_alive(), "load generator never finished"
+        art = result["art"]
+
+        # ZERO dropped or errored requests across the whole exercise
+        assert art["errors"] == 0, art["error_samples"]
+        assert art["completed"] == 120
+
+        # ZERO request-path compiles across the swap: the new params
+        # dispatched through the SAME warm executables
+        metrics = serve_loadtest.scrape_metrics(url)
+        assert serve_loadtest.metric_value(
+            metrics, "eksml_serve_request_path_compiles_total") == 0.0
+        assert serve_loadtest.metric_value(
+            metrics, "eksml_serve_reloads_total") == 1.0
+        assert serve_loadtest.metric_value(
+            metrics, "eksml_serve_reload_rejected_total",
+            '{reason="integrity"}') >= 1.0
+        assert serve_loadtest.metric_value(
+            metrics, "eksml_serve_params_step") == 4.0
+
+        # the flip boundary: every response names its checkpoint, and
+        # the steps partition exactly at the recorded serve_reload
+        # event (old-params responses STARTED before the swap,
+        # new-params responses COMPLETED after it)
+        events = _serve_events(serve_dir, "stable")
+        reloads = [e for e in events if e["kind"] == "serve_reload"]
+        assert len(reloads) == 1
+        assert reloads[0]["step"] == 4
+        assert reloads[0]["previous_step"] == 2
+        t_swap = reloads[0]["time"]
+        rejected = [e for e in events
+                    if e["kind"] == "serve_reload_rejected"]
+        assert rejected and rejected[0]["step"] == 6
+        assert rejected[0]["reason"] == "integrity"
+
+        steps_seen = {r["params_step"] for r in art["records"]}
+        assert steps_seen == {2, 4}, steps_seen
+        for r in art["records"]:
+            started = r["t_wall"] - r["total_ms"] / 1e3
+            if r["params_step"] == 2:
+                assert started <= t_swap + 0.05, (
+                    "a request started after the swap still served "
+                    "step 2: %r" % r)
+            else:
+                assert r["t_wall"] >= t_swap - 0.05, (
+                    "a step-4 response completed before the swap "
+                    "event: %r" % r)
+
+        # graceful exit still holds with the reload machinery wired
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, open(log_path).read()[-3000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+@pytest.mark.slow
+def test_canary_shadow_score_and_rollback(tmp_path, compile_cache,
+                                          trained_ckpts):
+    """proc-canary-rollback: the full rollout loop against two live
+    servers.  Incumbent serves step 2, canary serves step 6; a
+    recorded request bank replays as shadow traffic at both.  Under a
+    strict drift gate the (genuinely different) canary checkpoint is
+    ROLLED BACK — the controller demotes it to the incumbent's step
+    via /admin/reload.  Re-armed with the canary on step 6 and
+    lenient gates, a promote streak flips the INCUMBENT to step 6.
+    Every verdict/actuation lands as flight events + canary metrics;
+    run_report renders the timeline."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import eksml_operator
+    import serve_loadtest
+
+    serve_dir = str(tmp_path / "serve_log")
+    os.makedirs(os.path.join(serve_dir, "checkpoints"))
+    for s in (2, 6):
+        _publish_ckpt(trained_ckpts, serve_dir, s)
+
+    inc_port = str(tmp_path / "inc.port")
+    can_port = str(tmp_path / "can.port")
+    inc_log = str(tmp_path / "inc.log")
+    can_log = str(tmp_path / "can.log")
+    # both tracks share the logdir (distinct --serve-id keeps their
+    # event files apart); poll 0 — params move ONLY via /admin/reload
+    inc = _start_serve(serve_dir, inc_port, inc_log, compile_cache,
+                       serve_id="stable", step=2)
+    can = _start_serve(serve_dir, can_port, can_log, compile_cache,
+                       serve_id="canary", step=6)
+    try:
+        inc_url = _serve_url(inc, inc_port, inc_log)
+        can_url = _serve_url(can, can_port, can_log)
+        assert serve_loadtest.wait_ready(
+            inc_url, budget=900)["params_step"] == 2
+        assert serve_loadtest.wait_ready(
+            can_url, budget=900)["params_step"] == 6
+
+        bank = serve_loadtest.build_bank(
+            seed=3, sizes="100x80,80x100", requests=12)
+
+        # phase 1 — strict drift gate: steps 2 and 6 genuinely
+        # disagree (different optimizer states), so the canary is
+        # rolled back on the first score
+        strict = {"CANARY_MIN_REQUESTS": 5,
+                  "CANARY_ERROR_RATE_MAX": 0.5,
+                  "CANARY_P99_RATIO_MAX": 1000.0,
+                  "CANARY_DRIFT_MAX": 0.0,
+                  "CANARY_PROMOTE_STREAK": 2}
+        ctrl = eksml_operator.PromotionController(
+            serve_dir, inc_url, can_url, bank, strict,
+            raw_topk=16, concurrency=3, timeout=120)
+        out = ctrl.tick()
+        assert out["verdict"] == "rollback", out
+        assert out["score"]["scored"] == 12
+        assert out["score"]["drift"]["mean"] > 0.0
+        assert out["reload"]["ok"] is True
+        # the canary now serves the incumbent's checkpoint again
+        assert serve_loadtest.fetch_health(
+            can_url)["params_step"] == 2
+        assert serve_loadtest.fetch_health(
+            inc_url)["params_step"] == 2
+        # converged fleet: the next tick holds (nothing to score)
+        assert ctrl.tick()["verdict"] == "hold"
+
+        # phase 2 — the canary picks up step 6 again (as its watcher
+        # would on a fresh training checkpoint) and clean gates let a
+        # promote streak flip the incumbent
+        assert eksml_operator.post_reload(
+            can_url, step=6)["ok"] is True
+        lenient = dict(strict, CANARY_DRIFT_MAX=1.0)
+        ctrl2 = eksml_operator.PromotionController(
+            serve_dir, inc_url, can_url, bank, lenient,
+            raw_topk=16, concurrency=3, timeout=120)
+        first = ctrl2.tick()
+        assert first["verdict"] == "promote", first
+        assert "streak 1/2" in first["reason"]
+        assert serve_loadtest.fetch_health(
+            inc_url)["params_step"] == 2  # not yet: streak gating
+        second = ctrl2.tick()
+        assert second["verdict"] == "promote", second
+        assert second["reload"]["ok"] is True
+        assert serve_loadtest.fetch_health(
+            inc_url)["params_step"] == 6
+        assert ctrl2.tick()["verdict"] == "hold"  # converged at 6
+
+        # evidence trail: flight events, canary metrics, run_report
+        cd_events = _serve_events(serve_dir, "cd")
+        kinds = [e["kind"] for e in cd_events]
+        assert "canary_score" in kinds
+        rb = [e for e in cd_events if e["kind"] == "canary_rollback"]
+        assert rb and rb[0]["from_step"] == 6 and rb[0]["to_step"] == 2
+        pm = [e for e in cd_events if e["kind"] == "canary_promote"]
+        assert pm and pm[0]["step"] == 6 and pm[0]["previous_step"] == 2
+        stable_events = _serve_events(serve_dir, "stable")
+        assert any(e["kind"] == "serve_reload" and e["step"] == 6
+                   for e in stable_events)
+
+        from eksml_tpu.telemetry.exporter import render_openmetrics
+
+        body = render_openmetrics(ctrl.registry)
+        assert serve_loadtest.metric_value(
+            body, "eksml_serve_canary_rollbacks_total") == 1.0
+        assert serve_loadtest.metric_value(
+            body, "eksml_serve_canary_scores_total") == 1.0
+        body2 = render_openmetrics(ctrl2.registry)
+        assert serve_loadtest.metric_value(
+            body2, "eksml_serve_canary_promotions_total") == 1.0
+        assert serve_loadtest.metric_value(
+            body2, "eksml_serve_canary_verdicts_total",
+            '{verdict="promote"}') == 2.0
+
+        from tools import run_report
+
+        report = run_report.render_report(serve_dir)
+        assert "## Deployments (serving hot-reload / canary)" in report
+        assert "canary_rollback" in report
+        assert "canary_promote" in report
+
+        for p in (inc, can):
+            p.send_signal(signal.SIGTERM)
+        assert inc.wait(timeout=120) == 0
+        assert can.wait(timeout=120) == 0
+    finally:
+        for p in (inc, can):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)

@@ -365,3 +365,61 @@ def test_autoscale_section_joins_decisions_and_downtime(tmp_path):
                in ln for ln in lines)
     assert any("| relaunch | shrink | fsdp4 | 4 | 77 "
                "| relaunch gap 0.4 s |" in ln for ln in lines)
+
+
+def test_deployments_section_renders_cd_timeline(tmp_path):
+    """The Deployments section (ISSUE 17): hot-reload / rejection /
+    shadow-score / promotion / rollback flight events — banked by the
+    serve pods and the promotion controller into their per-host event
+    files — render as one merged timeline with hold verdicts
+    compressed; degrades to a pointer when no serving fleet ran."""
+    logdir = str(tmp_path / "run")
+    os.makedirs(logdir)
+    # degraded: no events -> pointer, never a crash
+    report = run_report.render_report(logdir)
+    assert "## Deployments (serving hot-reload / canary)" in report
+    assert "No serving deployment events" in report
+    assert "--promote" in report
+
+    stable = telemetry.FlightRecorder(
+        path=telemetry.events_path_for(logdir, "stable"),
+        host_id="stable")
+    stable.record("serve_reload", step=4, previous_step=2,
+                  duration_ms=812.5, verification="verified 3 file(s)")
+    stable.record("serve_reload_rejected", step=6, reason="integrity",
+                  detail="step 6: size mismatch (truncated commit?)")
+    stable.close()
+    cd = telemetry.FlightRecorder(
+        path=telemetry.events_path_for(logdir, "cd"), host_id="cd")
+    cd.record("canary_score", verdict="hold", reason="converged",
+              incumbent_step=4, canary_step=4)
+    cd.record("canary_score", verdict="rollback", reason="drift",
+              incumbent_step=4, canary_step=6, p99_ratio=1.01,
+              error_rate=0.0, drift=0.42)
+    cd.record("canary_rollback", from_step=6, to_step=4,
+              reload_ok=True)
+    cd.record("canary_score", verdict="promote", reason="gates green",
+              incumbent_step=4, canary_step=8, p99_ratio=0.99,
+              error_rate=0.0, drift=0.0)
+    cd.record("canary_promote", step=8, previous_step=4, streak=2,
+              reload_ok=True)
+    cd.close()
+
+    report = run_report.render_report(logdir)
+    assert ("1 hot-reload(s), 1 rejected candidate(s); 3 shadow "
+            "score(s) (1 promote, 1 rollback, 1 hold verdicts) -> "
+            "1 promotion(s), 1 rollback(s) actuated." in report)
+    lines = report.splitlines()
+    # hold verdicts are counted but compressed out of the timeline
+    assert not any("| hold:" in ln for ln in lines)
+    assert any("| serve_reload | 4 | 2 -> 4 in 812.5 ms "
+               "(verified 3 file(s))" in ln for ln in lines)
+    assert any("reason=integrity: step 6: size mismatch"
+               in ln for ln in lines)
+    assert any("| canary_score | 4/6 | rollback:" in ln
+               for ln in lines)
+    assert any("| canary_rollback | 4 | 6 -> 4 (reload_ok=True)"
+               in ln for ln in lines)
+    assert any("| canary_promote | 8 | 4 -> 8 after streak 2 "
+               "(reload_ok=True)" in ln for ln in lines)
+    assert "Rejections by reason: integrity×1" in report

@@ -1,0 +1,513 @@
+"""Continuous-deployment serving layer (ISSUE 17): verified checkpoint
+hot-reload (eksml_tpu/serve/reload.py) + the promotion controller's
+shadow-score math.
+
+The ``unit-serve-reload`` rung of the chaos ladder:
+
+* swap-under-load bit-parity — a params swap mid-traffic never mixes
+  trees inside a micro-batch: every response is BIT-identical to the
+  same image served steady-state under whichever params its
+  ``params_step`` names, and the warm AOT cache is reused as-is
+  (``request_path_compiles`` stays 0 across the swap);
+* fail-closed rejections — unreadable manifest, failed restore,
+  structure mismatch, and mid-drain candidates each leave the OLD
+  params serving, answer an outcome dict (never raise), bump the
+  preregistered ``eksml_serve_reload_rejected{reason=}`` counter and
+  bank a ``serve_reload_rejected`` flight event;
+* watcher memory — a watcher-initiated rejection is remembered (no
+  hot-loop on a bad candidate) while an explicit ``/admin/reload``
+  retries it;
+* shadow-score drift math (tools/serve_loadtest.py) and the
+  record/replay bank's bit-exact image regeneration.
+
+The subprocess rungs (live server hot-reload under open-loop load;
+canary shadow-score + rollback) live in tests/test_fault_tolerance.py.
+ONE module-scoped engine (single 128x128 bucket x single batch rung 4
+= 1 compile) serves every test here.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _tiny_serve_cfg():
+    from eksml_tpu import config as config_mod
+    from eksml_tpu.config import SMOKE_OVERRIDES
+
+    cfg = config_mod.config.clone()
+    cfg.freeze(False)
+    cfg.update_args(SMOKE_OVERRIDES)
+    cfg.PREPROC.TEST_SHORT_EDGE_SIZE = 128
+    cfg.DATA.SYNTHETIC = True
+    cfg.RPN.TEST_PRE_NMS_TOPK = 64
+    cfg.RPN.TEST_POST_NMS_TOPK = 32
+    cfg.SERVE.MAX_BATCH_SIZE = 4
+    # ONE batch rung: every dispatch (fill 1..4) pads into the same
+    # batch-4 executable, so steady-state references and under-load
+    # responses share one XLA program — bit-parity is well-defined
+    cfg.SERVE.BATCH_SIZES = (4,)
+    cfg.SERVE.MAX_BATCH_DELAY_MS = 5.0
+    cfg.freeze()
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def serve_cfg():
+    return _tiny_serve_cfg()
+
+
+@pytest.fixture(scope="module")
+def engine_and_params(serve_cfg):
+    """ONE warmed engine (1 bucket x 1 rung = 1 compile) plus a second
+    params tree with the same structure — the hot-reload candidate."""
+    from eksml_tpu.models import MaskRCNN
+    from eksml_tpu.serve.__main__ import _random_params
+    from eksml_tpu.serve.engine import InferenceEngine, bucket_schedule
+
+    model = MaskRCNN.from_config(serve_cfg)
+    buckets = bucket_schedule(serve_cfg)
+    params_a = _random_params(serve_cfg, model, buckets, seed=0)
+    params_b = _random_params(serve_cfg, model, buckets, seed=1)
+    eng = InferenceEngine(serve_cfg, params=params_a, model=model)
+    assert eng.warmup() == 1
+    return eng, params_a, params_b
+
+
+def _img(seed, h=100, w=80):
+    return np.random.RandomState(seed).randint(
+        0, 255, (h, w, 3)).astype(np.uint8)
+
+
+def _det_key(dets):
+    """Bitwise-comparable view of a detection list."""
+    return [(d.class_id, float(d.score), tuple(float(x) for x in d.box))
+            for d in dets]
+
+
+# ---------------------------------------------------------------------
+# engine swap: structure gate + snapshot consistency
+# ---------------------------------------------------------------------
+
+
+def test_swap_params_rejects_structure_and_shape_mismatch(
+        engine_and_params):
+    import jax
+
+    engine, params_a, _ = engine_and_params
+    with pytest.raises(ValueError, match="structure"):
+        engine.swap_params({"not": "the tree"}, step=9)
+    # same structure, one leaf reshaped: the AOT executables were
+    # lowered against the serving avals — must be refused by path name
+    bad = jax.tree.map(lambda x: x, params_a)
+    leaves, treedef = jax.tree.flatten(bad)
+    leaves[0] = np.zeros(np.asarray(leaves[0]).shape + (1,),
+                         np.asarray(leaves[0]).dtype)
+    with pytest.raises(ValueError, match="leaf .* changed"):
+        engine.swap_params(jax.tree.unflatten(treedef, leaves), step=9)
+    # both rejections left the serving params untouched
+    assert engine.params_step is None
+
+
+def test_swap_under_load_bit_parity(engine_and_params, serve_cfg):
+    """The tentpole pin: responses produced WHILE params swap A->B are
+    each bit-identical to the steady-state response of whichever tree
+    their ``params_step`` names — no half-swapped batch, no recompile."""
+    from eksml_tpu.serve.batcher import MicroBatcher
+
+    engine, params_a, params_b = engine_and_params
+    compiles_before = engine.compiles
+    imgs = [_img(s) for s in range(4)]
+    bat = MicroBatcher(engine, serve_cfg)
+    try:
+        # steady-state references under each tree, via the same
+        # batcher + executable the under-load run uses
+        engine.swap_params(params_a, step=100)
+        ref_a = [_det_key(bat.submit(im, score_thresh=-1.0)
+                          .wait_result(timeout=120)) for im in imgs]
+        engine.swap_params(params_b, step=200)
+        ref_b = [_det_key(bat.submit(im, score_thresh=-1.0)
+                          .wait_result(timeout=120)) for im in imgs]
+        assert ref_a != ref_b  # different params must differ somewhere
+        engine.swap_params(params_a, step=100)
+
+        results, done = [], threading.Event()
+        res_lock = threading.Lock()
+
+        def client(tid):
+            for i in range(8):
+                r = bat.submit(imgs[(tid + i) % 4], score_thresh=-1.0)
+                dets = r.wait_result(timeout=120)
+                with res_lock:
+                    results.append(((tid + i) % 4, r.served_step,
+                                    _det_key(dets)))
+                    if len(results) >= 8:
+                        done.set()
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        # swap mid-stream, once a first wave has served under A
+        assert done.wait(timeout=120)
+        engine.swap_params(params_b, step=200)
+        for t in threads:
+            t.join(timeout=120)
+        assert len(results) == 24
+        steps = {s for _, s, _ in results}
+        assert steps <= {100, 200}
+        assert 100 in steps, "no response served before the swap"
+        assert 200 in steps, "no response served after the swap"
+        for idx, step, key in results:
+            want = ref_a[idx] if step == 100 else ref_b[idx]
+            assert key == want, (
+                f"response under step {step} for image {idx} does not "
+                "bit-match its steady-state reference — params mixed "
+                "inside a micro-batch")
+    finally:
+        bat.close(drain=True)
+    # the whole exercise reused the single warm executable
+    assert engine.compiles == compiles_before
+    assert engine.request_path_compiles == 0
+
+
+# ---------------------------------------------------------------------
+# ReloadManager: fail-closed rejection paths
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture()
+def recorder(tmp_path):
+    from eksml_tpu.telemetry import recorder as rec_mod
+    from eksml_tpu.telemetry.recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=64,
+                         path=str(tmp_path / "events-host0.jsonl"))
+    prev = rec_mod.install(rec)
+    yield rec
+    rec_mod.install(prev)
+    rec.close()
+
+
+def _mgr(engine, logdir, **kw):
+    from eksml_tpu.serve.reload import ReloadManager
+    from eksml_tpu.telemetry.registry import MetricRegistry
+
+    kw.setdefault("registry", MetricRegistry())
+    return ReloadManager(engine, str(logdir), **kw)
+
+
+def _publish(logdir, step, manifest=True, digest=False):
+    """A committed-looking candidate: checkpoints/<step>/ with one
+    payload file, plus (optionally) its real integrity manifest."""
+    from eksml_tpu.resilience import integrity
+
+    root = os.path.join(str(logdir), "checkpoints")
+    d = os.path.join(root, str(step))
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "payload.bin"), "wb") as f:
+        f.write(b"x" * 64)
+    if manifest:
+        integrity.write_manifest(root, step, digest=digest)
+    return root
+
+
+def test_missing_manifest_rejected_old_params_serving(
+        engine_and_params, tmp_path, recorder):
+    engine, params_a, _ = engine_and_params
+    engine.swap_params(params_a, step=100)
+    _publish(tmp_path, 104, manifest=False)
+    mgr = _mgr(engine, tmp_path)
+    out = mgr.reload_step(104)
+    assert out["ok"] is False and out["reason"] == "integrity"
+    assert "manifest" in out["detail"]
+    assert engine.params_step == 100  # old params keep serving
+    assert mgr.rejected == 1 and mgr.reloads == 0
+    evs = [e for e in recorder.tail()
+           if e["kind"] == "serve_reload_rejected"]
+    assert evs and evs[-1]["reason"] == "integrity"
+    assert evs[-1]["step"] == 104
+
+
+def test_restore_failure_rejected_and_watcher_remembers(
+        engine_and_params, tmp_path, recorder):
+    engine, params_a, _ = engine_and_params
+    engine.swap_params(params_a, step=100)
+    _publish(tmp_path, 104, digest=True)
+
+    calls = []
+
+    def broken_restore(step):
+        calls.append(step)
+        raise IOError("shard went missing mid-read")
+
+    mgr = _mgr(engine, tmp_path, restore_fn=broken_restore)
+    # watcher-initiated: rejected AND remembered
+    out = mgr.poll_once()
+    assert out["ok"] is False and out["reason"] == "restore"
+    assert engine.params_step == 100
+    assert calls == [104]
+    # second poll skips the remembered step without touching restore
+    assert mgr.poll_once() is None
+    assert calls == [104]
+    assert mgr.rejected == 1
+    # an explicit /admin/reload retries it (operator repaired it?)
+    out = mgr.reload_step(104)
+    assert out["ok"] is False and calls == [104, 104]
+    assert mgr.rejected == 2
+
+
+def test_structure_mismatch_rejected(engine_and_params, tmp_path,
+                                     recorder):
+    engine, params_a, _ = engine_and_params
+    engine.swap_params(params_a, step=100)
+    _publish(tmp_path, 104, digest=True)
+    mgr = _mgr(engine, tmp_path,
+               restore_fn=lambda step: {"wrong": "tree"})
+    out = mgr.reload_step(104)
+    assert out["ok"] is False and out["reason"] == "structure"
+    assert engine.params_step == 100
+
+
+def test_draining_rejects_before_and_after_restore(
+        engine_and_params, tmp_path, recorder):
+    engine, params_a, params_b = engine_and_params
+    engine.swap_params(params_a, step=100)
+    _publish(tmp_path, 104, digest=True)
+    # drain already in progress: rejected before any restore I/O
+    mgr = _mgr(engine, tmp_path, restore_fn=lambda s: params_b,
+               is_draining=lambda: True)
+    out = mgr.reload_step(104)
+    assert out["ok"] is False and out["reason"] == "draining"
+    assert engine.params_step == 100
+    # SIGTERM lands DURING the restore: the re-check under the shared
+    # lock rejects the swap (drain wins the race)
+    flag = {"draining": False}
+
+    def restore_then_drain(step):
+        flag["draining"] = True
+        return params_b
+
+    mgr = _mgr(engine, tmp_path, restore_fn=restore_then_drain,
+               is_draining=lambda: flag["draining"])
+    out = mgr.reload_step(104)
+    assert out["ok"] is False and out["reason"] == "draining"
+    assert engine.params_step == 100
+
+
+def test_successful_reload_swaps_prunes_and_banks_event(
+        engine_and_params, tmp_path, recorder):
+    from eksml_tpu.telemetry.exporter import render_openmetrics
+    from eksml_tpu.telemetry.registry import MetricRegistry
+
+    engine, params_a, params_b = engine_and_params
+    engine.swap_params(params_a, step=100)
+    _publish(tmp_path, 102, manifest=False)   # bad earlier candidate
+    _publish(tmp_path, 104, digest=True)
+    reg = MetricRegistry()
+    mgr = _mgr(engine, tmp_path, restore_fn=lambda s: params_b,
+               registry=reg)
+    mgr._rejected[102] = "integrity"
+    assert mgr.latest_candidate() == 104
+    out = mgr.poll_once()
+    assert out["ok"] is True and out["step"] == 104
+    assert out["previous_step"] == 100
+    assert engine.params_step == 104
+    assert mgr.reloads == 1
+    assert mgr._rejected == {}  # <= new serving step: pruned
+    evs = [e for e in recorder.tail() if e["kind"] == "serve_reload"]
+    assert evs and evs[-1]["step"] == 104
+    assert evs[-1]["previous_step"] == 100
+    # nothing newer: the watcher goes back to sleep
+    assert mgr.poll_once() is None
+    # the whole eksml_serve_reload_* family is preregistered and live
+    body = render_openmetrics(reg)
+    assert "eksml_serve_reloads_total 1" in body
+    for reason in ("integrity", "restore", "structure", "draining",
+                   "no_step"):
+        assert f'reason="{reason}"' in body
+    assert "eksml_serve_params_step 104" in body
+    # restore the module engine for later tests
+    engine.swap_params(params_a, step=None)
+
+
+def test_no_step_outcome_without_candidates(engine_and_params,
+                                            tmp_path):
+    engine, _, _ = engine_and_params
+    mgr = _mgr(engine, tmp_path)
+    out = mgr.reload_step()
+    assert out["ok"] is False and out["reason"] == "no_step"
+    assert mgr.poll_once() is None
+
+
+# ---------------------------------------------------------------------
+# shadow-traffic scoring math (tools/serve_loadtest.py)
+# ---------------------------------------------------------------------
+
+
+def _loadtest():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import serve_loadtest
+    return serve_loadtest
+
+
+def test_request_bank_regenerates_images_bit_exact(tmp_path):
+    lt = _loadtest()
+    bank = lt.build_bank(seed=7, sizes="100x80,80x100", requests=6)
+    assert bank["kind"] == "serve_request_bank"
+    assert len(bank["requests"]) == 6
+    for row in bank["requests"]:
+        a = lt.bank_image(bank, row)
+        b = lt.gen_image(7, row["idx"], [(row["h"], row["w"])])
+        np.testing.assert_array_equal(a, b)
+
+
+def test_detection_drift_raw_topk_and_fallback():
+    lt = _loadtest()
+    raw = {"scores": [0.9, 0.5], "classes": [1, 2],
+           "boxes": [[0, 0, 10, 10], [5, 5, 20, 20]]}
+    assert lt.detection_drift({"raw_top": raw}, {"raw_top": raw}) == 0.0
+    other = {"scores": [0.9, 0.5], "classes": [3, 2],
+             "boxes": [[0, 0, 10, 10], [5, 5, 20, 20]]}
+    d = lt.detection_drift({"raw_top": raw}, {"raw_top": other})
+    assert d == pytest.approx(0.5)  # one of two ranks flipped class
+    # fallback (no raw_top): greedy IoU matching over detections
+    det = [{"box": [0, 0, 10, 10], "class_id": 1, "score": 0.9}]
+    assert lt.detection_drift({"detections": det},
+                              {"detections": list(det)}) == 0.0
+    assert lt.detection_drift({"detections": det},
+                              {"detections": []}) == 1.0
+    assert lt.detection_drift({"detections": []},
+                              {"detections": []}) == 0.0
+
+
+def test_shadow_artifact_naming(tmp_path):
+    lt = _loadtest()
+    p1 = lt.next_bank_path(str(tmp_path), prefix="shadow")
+    assert os.path.basename(p1) == "shadow_r1.json"
+    open(p1, "w").write("{}")
+    assert os.path.basename(
+        lt.next_bank_path(str(tmp_path), prefix="shadow")) == \
+        "shadow_r2.json"
+
+
+# ---------------------------------------------------------------------
+# preemption-forecast publisher (tools/preemption_forecast.py)
+# ---------------------------------------------------------------------
+
+
+def _forecast_mod():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import preemption_forecast
+    return preemption_forecast
+
+
+def test_forecast_file_provider_and_capacity_rmw(tmp_path):
+    import json
+
+    pf = _forecast_mod()
+    notices = tmp_path / "notices.json"
+    notices.write_text(json.dumps(
+        {"total_chips": 16,
+         "notices": [{"node": "n1", "chips": 4}]}))
+    cap = tmp_path / "capacity.json"
+    cap.write_text(json.dumps(
+        {"available_chips": 16, "preemption_forecast": 0.0,
+         "who": "operator"}))
+    got = pf.publish_once(pf.FileNoticeProvider(str(notices)),
+                          str(cap))
+    assert got == pytest.approx(0.25)
+    doc = json.loads(cap.read_text())
+    assert doc["preemption_forecast"] == pytest.approx(0.25)
+    assert doc["available_chips"] == 16  # other fields preserved
+    assert doc["who"] == "operator"
+    # torn notices file: NO signal, NO write (a crashed feed must not
+    # clear a standing hold)
+    notices.write_text('{"total_chips": 16, "notices": [')
+    assert pf.publish_once(pf.FileNoticeProvider(str(notices)),
+                           str(cap)) is None
+    assert json.loads(cap.read_text())["preemption_forecast"] == \
+        pytest.approx(0.25)
+    # absent capacity file: annotator never creates the document
+    assert pf.update_capacity_file(str(tmp_path / "nope.json"),
+                                   0.5) is False
+    assert not os.path.exists(tmp_path / "nope.json")
+
+
+def test_forecast_kubectl_provider_parses_taints():
+    pf = _forecast_mod()
+    prov = pf.KubectlNoticeProvider()
+
+    def node(ready, chips, taints=()):
+        return {
+            "status": {
+                "conditions": [{"type": "Ready",
+                                "status": "True" if ready else "False"}],
+                "allocatable": {"google.com/tpu": str(chips)}},
+            "spec": {"taints": [{"key": k} for k in taints]}}
+
+    doc = {"items": [
+        node(True, 8),
+        node(True, 4, taints=("ToBeDeletedByClusterAutoscaler",)),
+        node(False, 4),                       # NotReady: not counted
+        node(True, 4, taints=("app.example/custom",)),
+    ]}
+    sig = prov.parse(doc)
+    assert sig.total_chips == 16
+    assert sig.chips_on_notice == 4
+    assert sig.forecast() == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------------------
+# promotion_verdict decision table (tools/eksml_operator.py --promote)
+# ---------------------------------------------------------------------
+
+
+def _operator_mod():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import eksml_operator
+    return eksml_operator
+
+
+_KNOBS = {"CANARY_MIN_REQUESTS": 8,
+          "CANARY_ERROR_RATE_MAX": 0.02,
+          "CANARY_P99_RATIO_MAX": 1.5,
+          "CANARY_DRIFT_MAX": 0.1,
+          "CANARY_PROMOTE_STREAK": 3}
+
+
+def _score(scored=20, err=0.0, p99=1.0, drift=0.0):
+    return {"scored": scored, "canary_error_rate": err,
+            "p99_ratio": p99,
+            "drift": None if drift is None else {"mean": drift}}
+
+
+@pytest.mark.parametrize("score,verdict,reason_frag", [
+    # every gate green -> promote (streak gating is the CALLER's job)
+    (_score(), "promote", "all gates passed"),
+    # one breached gate -> rollback, immediately
+    (_score(drift=0.3), "rollback", "output drift"),
+    (_score(p99=2.0), "rollback", "p99"),
+    (_score(err=0.5), "rollback", "error rate"),
+    # the asymmetry that matters: a DEAD canary (every request errors,
+    # zero scored pairs) is judged on error rate BEFORE the scoring
+    # floor — it rolls back, it does not hold forever
+    (_score(scored=0, err=1.0, p99=None, drift=None),
+     "rollback", "error rate"),
+    # thin or unscorable evidence -> hold, never promote OR demote
+    (_score(scored=3), "hold", "not enough evidence"),
+    (_score(drift=None), "hold", "unscorable"),
+    (_score(p99=None), "hold", "unscorable"),
+])
+def test_promotion_verdict_decision_table(score, verdict, reason_frag):
+    op = _operator_mod()
+    got, reason = op.promotion_verdict(score, _KNOBS)
+    assert got == verdict, (score, got, reason)
+    assert reason_frag in reason, reason

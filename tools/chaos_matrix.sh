@@ -60,6 +60,25 @@
 # takes SIGTERM mid-load — zero dropped in-flight requests, 503 on
 # new ones, clean exit 0, and the mid-run /metrics scrape parses the
 # eksml_serve_* family set as strict OpenMetrics.
+# unit-serve-reload covers the continuous-deployment layer (ISSUE 17,
+# eksml_tpu/serve/reload.py + tools/eksml_operator.py --promote):
+# swap-under-load bit-parity (responses match offline inference under
+# BOTH param sets, every response naming the checkpoint that served
+# it), rejected candidates (unreadable manifest, failed restore,
+# structure mismatch, mid-drain) leaving the old params serving with
+# a serve_reload_rejected event, the promotion_verdict decision table
+# (error-rate gate first — a dead canary rolls back, never holds
+# forever), shadow-score drift math, and the preemption-forecast
+# publisher.  proc-serve-reload is the runtime proof: a live server
+# under open-loop load hot-reloads a checkpoint published mid-run —
+# zero dropped/errored requests, zero request-path compiles, and the
+# response stream flips params_step exactly at the recorded
+# serve_reload boundary; a corrupted-manifest candidate is rejected
+# with the old params still serving.  proc-canary-rollback drives the
+# full rollout loop: incumbent + canary servers on different steps,
+# a recorded request bank replayed as shadow traffic, the promotion
+# controller scoring the pair — a regressed canary is rolled back to
+# the incumbent's step, then (lenient gates) a healthy one promoted.
 # unit-autoscale covers the elastic-autoscaling decision half (ISSUE
 # 16, eksml_tpu/resilience/autoscale.py + tools/eksml_operator.py):
 # plan_mesh-pinned topology ladders, the pure decide() driven through
@@ -102,6 +121,7 @@ RUNGS=(
   "unit-sharding-2d|tests/test_sharding.py -k 'tensor or 2d'"
   "unit-perfgate|tests/test_perf_gate.py"
   "unit-serve|tests/test_serve.py"
+  "unit-serve-reload|tests/test_serve_reload.py"
   "unit-autoscale|tests/test_autoscale.py"
   "unit-lint|tests/test_lint.py"
   "unit-lint-spmd|tests/test_lint_spmd.py"
@@ -121,6 +141,8 @@ RUNGS=(
   "proc-spmd-collective-skip|tests/test_fault_tolerance.py::test_rank_conditional_collective_skip_hangs_and_lints"
   "proc-lock-inversion|tests/test_fault_tolerance.py::test_lock_inversion_wedges_and_lints"
   "proc-serve-drain|tests/test_fault_tolerance.py::test_serve_drain_under_load"
+  "proc-serve-reload|tests/test_fault_tolerance.py::test_serve_hot_reload_under_load"
+  "proc-canary-rollback|tests/test_fault_tolerance.py::test_canary_shadow_score_and_rollback"
   "proc-data-chaos|tests/test_fault_tolerance.py::test_data_chaos_train_completes_with_quarantine"
   "proc-data-breaker|tests/test_fault_tolerance.py::test_quarantine_overflow_aborts_actionably"
 )

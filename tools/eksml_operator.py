@@ -392,6 +392,287 @@ def kubectl_serve_scale_cmd(deployment: str, namespace: str,
 
 
 # ---------------------------------------------------------------------
+# canary promotion controller (the continuous-deployment gate)
+# ---------------------------------------------------------------------
+
+# the controller's flight events get their own per-"host" file for the
+# same reason the operator does: two processes never append to one
+PROMOTER_HOST = "cd"
+
+
+def promotion_verdict(score: Dict, knobs: Dict) -> Tuple[str, str]:
+    """Pure decision: one shadow score → (verdict, reason).
+
+    Asymmetric by design — **rollback is immediate** (one breached
+    gate demotes the canary; a regressed checkpoint must leave live
+    traffic NOW), **promotion is patient** (the caller requires
+    ``CANARY_PROMOTE_STREAK`` consecutive ``promote`` verdicts before
+    flipping the incumbent, so one lucky replay cannot promote).  An
+    unscorable replay (too few pairs, no latency baseline) holds:
+    never promote OR demote on evidence that thin."""
+    scored = int(score.get("scored") or 0)
+    min_req = int(knobs["CANARY_MIN_REQUESTS"])
+    err_rate = score.get("canary_error_rate")
+    # error rate is judged even below the scoring floor: a canary
+    # failing every request scores zero pairs and would otherwise
+    # hold forever instead of rolling back
+    if err_rate is not None \
+            and float(err_rate) > float(knobs["CANARY_ERROR_RATE_MAX"]):
+        return ("rollback",
+                f"canary error rate {err_rate} > "
+                f"{knobs['CANARY_ERROR_RATE_MAX']}")
+    if scored < min_req:
+        return ("hold",
+                f"only {scored} scored pair(s) < CANARY_MIN_REQUESTS="
+                f"{min_req} — not enough evidence either way")
+    ratio = score.get("p99_ratio")
+    if ratio is not None \
+            and float(ratio) > float(knobs["CANARY_P99_RATIO_MAX"]):
+        return ("rollback",
+                f"canary p99 {ratio}x incumbent > "
+                f"{knobs['CANARY_P99_RATIO_MAX']}x")
+    drift = (score.get("drift") or {}).get("mean")
+    if drift is None or ratio is None:
+        return "hold", "replay unscorable (missing drift/latency axis)"
+    if float(drift) > float(knobs["CANARY_DRIFT_MAX"]):
+        return ("rollback",
+                f"output drift {drift} > {knobs['CANARY_DRIFT_MAX']} "
+                "— the canary checkpoint disagrees with the "
+                "incumbent beyond the gate")
+    return ("promote",
+            f"all gates passed (p99_ratio={ratio}, "
+            f"error_rate={err_rate}, drift={drift})")
+
+
+def post_reload(url: str, step: Optional[int] = None,
+                timeout: float = 300.0) -> Dict:
+    """``POST /admin/reload`` — the controller's demote/promote lever.
+    Answers the server's outcome dict; transport failures degrade to
+    ``{"ok": False, ...}`` (the controller records, never crashes)."""
+    import urllib.error
+    import urllib.request
+
+    body = json.dumps({} if step is None
+                      else {"step": int(step)}).encode("utf-8")
+    req = urllib.request.Request(
+        url.rstrip("/") + "/admin/reload", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read().decode("utf-8"))
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            return {"ok": False, "reason": "http", "detail": repr(e)}
+    except (OSError, ValueError) as e:
+        return {"ok": False, "reason": "unreachable", "detail": repr(e)}
+
+
+class PromotionController:
+    """Shadow-score the canary each tick; promote or roll back.
+
+    One tick = read both ``/healthz`` (which checkpoint is each track
+    serving?) → replay the banked traffic at both (``replay_shadow``)
+    → ``promotion_verdict`` → actuate via ``/admin/reload``:
+
+    - **rollback**: the canary reloads the INCUMBENT's step —
+      immediately, on the first breached gate;
+    - **promote**: after ``CANARY_PROMOTE_STREAK`` consecutive clean
+      scores, the incumbent reloads the CANARY's step (the canary
+      Deployment keeps serving it — promotion converges the fleet).
+
+    Every score/verdict lands in ``<logdir>/canary-host<id>.jsonl``,
+    flight events (``canary_score`` / ``canary_promote`` /
+    ``canary_rollback``) in ``events-host{PROMOTER_HOST}.jsonl``, and
+    the ``eksml_serve_canary_*`` series on the controller's exporter —
+    run_report's Deployments section replays the whole timeline."""
+
+    def __init__(self, logdir: str, incumbent_url: str,
+                 canary_url: str, bank: Dict, knobs: Dict,
+                 registry: Optional[MetricRegistry] = None,
+                 recorder: Optional[FlightRecorder] = None,
+                 raw_topk: int = 16, concurrency: int = 4,
+                 timeout: float = 120.0):
+        self.logdir = logdir
+        self.incumbent_url = incumbent_url
+        self.canary_url = canary_url
+        self.bank = bank
+        self.knobs = knobs
+        self.raw_topk = int(raw_topk)
+        self.concurrency = int(concurrency)
+        self.timeout = float(timeout)
+        self.streak = 0
+        self.promotions = 0
+        self.rollbacks = 0
+        self.bank_path = os.path.join(logdir, "canary-host0.jsonl")
+        self.bank_failures = 0
+        self.registry = registry or MetricRegistry()
+        self._preregister(self.registry)
+        self.recorder = recorder or FlightRecorder(
+            capacity=256,
+            path=os.path.join(logdir,
+                              f"events-host{PROMOTER_HOST}.jsonl"),
+            host_id=PROMOTER_HOST)
+
+    @staticmethod
+    def _preregister(registry: MetricRegistry) -> None:
+        registry.counter("eksml_serve_canary_scores",
+                         "shadow-replay scoring rounds completed")
+        for verdict in ("promote", "rollback", "hold"):
+            registry.counter("eksml_serve_canary_verdicts",
+                             "promotion verdicts by outcome",
+                             labels={"verdict": verdict})
+        registry.counter("eksml_serve_canary_promotions",
+                         "canary checkpoints promoted to the "
+                         "incumbent track")
+        registry.counter("eksml_serve_canary_rollbacks",
+                         "regressed canaries demoted back to the "
+                         "incumbent checkpoint")
+        registry.gauge("eksml_serve_canary_p99_ratio",
+                       "latest canary/incumbent latency p99 ratio")
+        registry.gauge("eksml_serve_canary_error_rate",
+                       "latest canary error rate over the shadow "
+                       "replay")
+        registry.gauge("eksml_serve_canary_drift",
+                       "latest mean detection-output drift vs the "
+                       "incumbent")
+
+    @staticmethod
+    def _loadtest():
+        """The scoring engine is serve_loadtest.py itself — one
+        replay/drift definition for the CLI and the controller."""
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import serve_loadtest
+        return serve_loadtest
+
+    def _bank_row(self, row: Dict) -> None:
+        row = dict(row)
+        row.setdefault("time", time.time())
+        try:
+            with open(self.bank_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except (OSError, TypeError, ValueError):
+            self.bank_failures += 1
+
+    def tick(self) -> Dict:
+        """One scoring round; returns ``{"verdict": ..., ...}``."""
+        lt = self._loadtest()
+        try:
+            inc = lt.fetch_health(self.incumbent_url,
+                                  timeout=self.timeout)
+            can = lt.fetch_health(self.canary_url,
+                                  timeout=self.timeout)
+        except (OSError, ValueError) as e:
+            return self._hold(f"health unreachable: {e!r}")
+        inc_step, can_step = inc.get("params_step"), \
+            can.get("params_step")
+        if can.get("status") != "ok" or inc.get("status") != "ok":
+            return self._hold(
+                f"track not serving (incumbent={inc.get('status')}, "
+                f"canary={can.get('status')})")
+        if can_step is None or can_step == inc_step:
+            # converged fleet: nothing to score until training
+            # publishes a new checkpoint and the canary picks it up
+            return self._hold(
+                f"tracks converged at step {inc_step} — no candidate")
+        score = lt.replay_shadow(self.bank, self.incumbent_url,
+                                 self.canary_url,
+                                 timeout=self.timeout,
+                                 raw_topk=self.raw_topk,
+                                 concurrency=self.concurrency)
+        self.registry.counter("eksml_serve_canary_scores", "").inc()
+        if score.get("p99_ratio") is not None:
+            self.registry.gauge("eksml_serve_canary_p99_ratio",
+                                "").set(float(score["p99_ratio"]))
+        self.registry.gauge("eksml_serve_canary_error_rate",
+                            "").set(float(score["canary_error_rate"]))
+        drift = (score.get("drift") or {}).get("mean")
+        if drift is not None:
+            self.registry.gauge("eksml_serve_canary_drift",
+                                "").set(float(drift))
+        verdict, reason = promotion_verdict(score, self.knobs)
+        self.registry.counter("eksml_serve_canary_verdicts", "",
+                              labels={"verdict": verdict}).inc()
+        self.recorder.record(
+            "canary_score", verdict=verdict, reason=reason,
+            incumbent_step=inc_step, canary_step=can_step,
+            p99_ratio=score.get("p99_ratio"),
+            error_rate=score.get("canary_error_rate"), drift=drift)
+        outcome = {"verdict": verdict, "reason": reason,
+                   "incumbent_step": inc_step,
+                   "canary_step": can_step, "score": score}
+        if verdict == "rollback":
+            self.streak = 0
+            self.rollbacks += 1
+            self.registry.counter("eksml_serve_canary_rollbacks",
+                                  "").inc()
+            demote = post_reload(self.canary_url, step=inc_step,
+                                 timeout=self.timeout)
+            self.recorder.record(
+                "canary_rollback", reason=reason,
+                from_step=can_step, to_step=inc_step,
+                reload_ok=bool(demote.get("ok")))
+            log.warning("canary ROLLED BACK (step %s -> %s): %s",
+                        can_step, inc_step, reason)
+            outcome["reload"] = demote
+        elif verdict == "promote":
+            self.streak += 1
+            streak_need = int(self.knobs["CANARY_PROMOTE_STREAK"])
+            if self.streak >= streak_need:
+                self.promotions += 1
+                self.registry.counter(
+                    "eksml_serve_canary_promotions", "").inc()
+                promote = post_reload(self.incumbent_url,
+                                      step=can_step,
+                                      timeout=self.timeout)
+                self.recorder.record(
+                    "canary_promote", step=can_step,
+                    previous_step=inc_step, streak=self.streak,
+                    reload_ok=bool(promote.get("ok")))
+                log.info("canary PROMOTED: incumbent now serves "
+                         "step %s (was %s)", can_step, inc_step)
+                outcome["reload"] = promote
+                self.streak = 0
+            else:
+                outcome["reason"] += (f"; streak {self.streak}/"
+                                      f"{streak_need} — promotion "
+                                      "needs more clean scores")
+        else:
+            self.streak = 0
+        self._bank_row({"kind": "canary_verdict", **{
+            k: outcome[k] for k in ("verdict", "reason",
+                                    "incumbent_step", "canary_step")},
+            "p99_ratio": score.get("p99_ratio"),
+            "error_rate": score.get("canary_error_rate"),
+            "drift": drift, "streak": self.streak})
+        return outcome
+
+    def _hold(self, reason: str) -> Dict:
+        self.registry.counter("eksml_serve_canary_verdicts", "",
+                              labels={"verdict": "hold"}).inc()
+        self._bank_row({"kind": "canary_verdict", "verdict": "hold",
+                        "reason": reason})
+        return {"verdict": "hold", "reason": reason}
+
+    def run(self, interval: float, stop_flag, max_ticks: int = 0,
+            once: bool = False) -> int:
+        ticks = 0
+        while not stop_flag.stop:
+            out = self.tick()
+            log.info("canary tick %d: %s (%s)", ticks,
+                     out["verdict"], out["reason"])
+            ticks += 1
+            if once or (max_ticks and ticks >= max_ticks):
+                break
+            deadline = time.monotonic() + max(0.5, interval)
+            while not stop_flag.stop \
+                    and time.monotonic() < deadline:
+                time.sleep(0.2)
+        return 0
+
+
+# ---------------------------------------------------------------------
 # the operator loop
 # ---------------------------------------------------------------------
 
@@ -784,6 +1065,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-restarts", type=int, default=10,
                    help="local-mode crash-relaunch budget (the "
                         "JobSet maxRestarts analogue)")
+    # canary promotion controller
+    p.add_argument("--promote", action="store_true",
+                   help="run the canary promotion controller instead "
+                        "of the autoscale loop: shadow-score the "
+                        "canary each tick, roll back on a breached "
+                        "gate, promote after CANARY_PROMOTE_STREAK "
+                        "clean scores")
+    p.add_argument("--incumbent-url", default="",
+                   help="stable track base URL (--promote)")
+    p.add_argument("--canary-url", default="",
+                   help="canary track base URL (--promote)")
+    p.add_argument("--shadow-bank", default="",
+                   help="recorded request bank (serve_loadtest.py "
+                        "--record) replayed for scoring (--promote)")
+    p.add_argument("--raw-topk", type=int, default=16,
+                   help="pre-threshold top-k drift signal depth")
+    p.add_argument("--shadow-concurrency", type=int, default=4)
+    p.add_argument("--shadow-timeout", type=float, default=120.0)
     # kubectl mode
     p.add_argument("--kubectl", default="kubectl")
     p.add_argument("--kubectl-timeout", type=float, default=60.0)
@@ -810,6 +1109,36 @@ def main(argv=None) -> int:
     knobs = knobs_with_defaults(
         getattr(getattr(config, "RESILIENCE", None), "AUTOSCALE",
                 None), RESILIENCE_AUTOSCALE_DEFAULTS)
+
+    if args.promote:
+        if not (args.incumbent_url and args.canary_url
+                and args.shadow_bank):
+            raise SystemExit("--promote needs --incumbent-url, "
+                             "--canary-url and --shadow-bank")
+        with open(args.shadow_bank) as f:
+            bank = json.load(f)
+        controller = PromotionController(
+            args.logdir, args.incumbent_url, args.canary_url, bank,
+            knobs, raw_topk=args.raw_topk,
+            concurrency=args.shadow_concurrency,
+            timeout=args.shadow_timeout)
+        exporter = TelemetryExporter(
+            port=args.port, registry=controller.registry,
+            port_file=os.path.join(args.logdir,
+                                   "telemetry-promoter.port"))
+        exporter.start()
+        stop_flag = _StopFlag()
+        signal.signal(signal.SIGTERM, stop_flag)
+        signal.signal(signal.SIGINT, stop_flag)
+        log.info("promotion controller up: incumbent=%s canary=%s "
+                 "bank=%d request(s)", args.incumbent_url,
+                 args.canary_url, len(bank.get("requests", ())))
+        try:
+            return controller.run(
+                args.interval or float(knobs["INTERVAL_SEC"]),
+                stop_flag, max_ticks=args.max_ticks, once=args.once)
+        finally:
+            exporter.stop()
     sharding = knobs_with_defaults(
         getattr(getattr(config, "TRAIN", None), "SHARDING", None),
         SHARDING_DEFAULTS)

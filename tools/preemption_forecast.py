@@ -1,0 +1,228 @@
+#!/usr/bin/env python3
+"""Publish ``preemption_forecast`` into the operator's capacity file.
+
+The autoscale policy (eksml_tpu/resilience/autoscale.py) holds
+scale-ups when ``preemption_forecast >= FORECAST_HOLD`` — but until
+now nothing populated that field: FileCapacityProvider read whatever
+a human (or a chaos rung) wrote.  This tool closes the loop with the
+same pluggable-provider pattern as the operator's capacity side:
+
+  forecast = chips_on_termination_notice / max(total_chips, 1)
+
+clamped to [0, 1].  Two notice providers:
+
+* ``FileNoticeProvider`` — a JSON stub for local runs and chaos
+  rungs: ``{"total_chips": 16, "notices": [{"node": "n1",
+  "chips": 4}, ...]}``.  Torn or absent file reads as "no signal"
+  (None), never as forecast 0 — a crashed notice feed must not
+  clear a standing hold.
+* ``KubectlNoticeProvider`` — the in-cluster signal: sums the TPU
+  allocatable of Ready nodes carrying a termination taint (GKE
+  spot/autoscaler keys by default) over the allocatable of all Ready
+  nodes.
+
+The write side is a read-modify-write of the operator's capacity
+file preserving every other field (``available_chips`` belongs to
+whoever feeds capacity), via tmp + ``os.replace`` so FileCapacity-
+Provider on the operator side never sees a torn document.  A missing
+or torn capacity file is skipped — this tool annotates the capacity
+feed, it does not own the file.
+
+Stdlib-only on purpose: it runs as a cluster sidecar/cron where the
+eksml_tpu package may not be installed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+
+class NoticeSignal:
+    """Chips under termination notice out of the fleet total."""
+
+    def __init__(self, chips_on_notice: int, total_chips: int):
+        self.chips_on_notice = max(0, int(chips_on_notice))
+        self.total_chips = max(0, int(total_chips))
+
+    def forecast(self) -> float:
+        return min(1.0, self.chips_on_notice / max(self.total_chips, 1))
+
+
+class FileNoticeProvider:
+    """JSON stub: ``{"total_chips": N, "notices": [{"node": ...,
+    "chips": M}, ...]}``.  The chaos rungs' wave driver."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def read(self) -> Optional[NoticeSignal]:
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            on_notice = sum(
+                int(n.get("chips", 0)) for n in doc.get("notices", []))
+            return NoticeSignal(on_notice, int(doc["total_chips"]))
+        except (OSError, ValueError, TypeError, KeyError):
+            return None  # torn mid-rewrite or absent: no signal, no write
+
+
+# Taint keys that mean "this node is going away": GKE spot/preemptible
+# termination, cluster-autoscaler scale-down candidates, and the
+# generic unschedulable cordon that precedes a drain.
+DEFAULT_TAINT_KEYS = (
+    "cloud.google.com/impending-node-termination",
+    "DeletionCandidateOfClusterAutoscaler",
+    "ToBeDeletedByClusterAutoscaler",
+    "node.kubernetes.io/unschedulable",
+)
+
+
+class KubectlNoticeProvider:
+    """Ready nodes carrying a termination taint vs all Ready nodes,
+    weighted by TPU allocatable."""
+
+    def __init__(self, resource: str = "google.com/tpu",
+                 selector: str = "",
+                 taint_keys: tuple = DEFAULT_TAINT_KEYS,
+                 kubectl: str = "kubectl", timeout: float = 30.0):
+        self.resource = resource
+        self.selector = selector
+        self.taint_keys = tuple(taint_keys)
+        self.kubectl = kubectl
+        self.timeout = timeout
+
+    def command(self) -> List[str]:
+        cmd = [self.kubectl, "get", "nodes", "-o", "json"]
+        if self.selector:
+            cmd += ["-l", self.selector]
+        return cmd
+
+    @staticmethod
+    def _node_ready(node: Dict) -> bool:
+        for cond in node.get("status", {}).get("conditions", []):
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return False
+
+    def _on_notice(self, node: Dict) -> bool:
+        for taint in node.get("spec", {}).get("taints", []) or []:
+            if taint.get("key") in self.taint_keys:
+                return True
+        return False
+
+    def parse(self, doc: Dict) -> Optional[NoticeSignal]:
+        total = on_notice = 0
+        for node in doc.get("items", []):
+            if not self._node_ready(node):
+                continue
+            alloc = node.get("status", {}).get("allocatable", {})
+            try:
+                chips = int(alloc.get(self.resource, 0))
+            except (TypeError, ValueError):
+                continue
+            total += chips
+            if self._on_notice(node):
+                on_notice += chips
+        return NoticeSignal(on_notice, total)
+
+    def read(self) -> Optional[NoticeSignal]:
+        try:
+            out = subprocess.run(
+                self.command(), capture_output=True, text=True,
+                timeout=self.timeout, check=False)
+            if out.returncode != 0:
+                return None
+            return self.parse(json.loads(out.stdout))
+        except (OSError, subprocess.TimeoutExpired,
+                json.JSONDecodeError):
+            return None
+
+
+def update_capacity_file(path: str, forecast: float) -> bool:
+    """Read-modify-write ``preemption_forecast`` into the capacity
+    file, preserving every other field.  Returns False (no write) when
+    the file is absent or torn — the capacity side owns the document;
+    we only annotate it."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if not isinstance(doc, dict):
+        return False
+    doc["preemption_forecast"] = round(max(0.0, min(1.0, float(forecast))), 6)
+    doc["forecast_updated_at"] = time.time()
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".",
+        prefix=".forecast-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)  # atomic: readers see old or new, never torn
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    return True
+
+
+def publish_once(provider, capacity_file: str) -> Optional[float]:
+    """One poll: read the notice signal, write the forecast.  Returns
+    the forecast written, or None when held (no signal / no file)."""
+    signal = provider.read()
+    if signal is None:
+        return None
+    forecast = signal.forecast()
+    if not update_capacity_file(capacity_file, forecast):
+        return None
+    return forecast
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--capacity-file", required=True,
+                   help="operator capacity JSON to annotate")
+    p.add_argument("--notices-file", default="",
+                   help="JSON notice stub; empty = kubectl provider")
+    p.add_argument("--selector", default="",
+                   help="kubectl node label selector")
+    p.add_argument("--resource", default="google.com/tpu")
+    p.add_argument("--taint-keys", default=",".join(DEFAULT_TAINT_KEYS),
+                   help="comma-separated taint keys meaning termination")
+    p.add_argument("--interval", type=float, default=15.0)
+    p.add_argument("--once", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.notices_file:
+        provider = FileNoticeProvider(args.notices_file)
+    else:
+        keys = tuple(k for k in args.taint_keys.split(",") if k)
+        provider = KubectlNoticeProvider(
+            resource=args.resource, selector=args.selector,
+            taint_keys=keys or DEFAULT_TAINT_KEYS)
+    while True:
+        forecast = publish_once(provider, args.capacity_file)
+        if forecast is None:
+            print("preemption_forecast: hold (no signal or no "
+                  "capacity file)", flush=True)
+        else:
+            print(f"preemption_forecast: {forecast:g} -> "
+                  f"{args.capacity_file}", flush=True)
+        if args.once:
+            return 0
+        time.sleep(max(args.interval, 1.0))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,8 +60,12 @@ GOLDEN_VALUES = {
                           "eksml-train:golden"},
     "jupyter": {"image": "REGION-docker.pkg.dev/PROJECT/eksml/"
                          "eksml-viz:golden"},
+    # canary.enabled=True here (production default is off) so the
+    # golden render AND the values-config-sync lint exercise the
+    # canary track's template + rendered --config keys every CI run
     "serve": {"image": "REGION-docker.pkg.dev/PROJECT/eksml/"
-                       "eksml-train:golden"},
+                       "eksml-train:golden",
+              "canary": {"enabled": True}},
     "autoscaler": {"image": "REGION-docker.pkg.dev/PROJECT/eksml/"
                             "eksml-train:golden"},
 }

@@ -456,6 +456,96 @@ def _autoscale_section(logdir: str) -> List[str]:
     return lines
 
 
+_DEPLOY_KINDS = ("serve_reload", "serve_reload_rejected",
+                 "canary_score", "canary_promote", "canary_rollback")
+
+
+def _deployments_section(events: List[Dict]) -> List[str]:
+    """The continuous-deployment trail (ISSUE 17): every hot-reload,
+    rejected candidate, shadow score and promotion/rollback the
+    serving fleet and its promotion controller banked to the flight
+    recorder, in one timeline.  Degrades to a pointer when no serving
+    fleet ran against this logdir."""
+    lines = ["## Deployments (serving hot-reload / canary)"]
+    rows = [e for e in events if e.get("kind") in _DEPLOY_KINDS]
+    if not rows:
+        lines += ["", "No serving deployment events — no hot-reload "
+                      "or canary activity against this logdir.  (The "
+                      "serve pods bank `serve_reload*` events to "
+                      "events-host<serve-id>.jsonl; "
+                      "`python tools/eksml_operator.py --promote ...` "
+                      "banks `canary_*` verdicts and actuations.)"]
+        return lines
+    reloads = [e for e in rows if e.get("kind") == "serve_reload"]
+    rejected = [e for e in rows if e.get("kind") == "serve_reload_rejected"]
+    scores = [e for e in rows if e.get("kind") == "canary_score"]
+    verdicts = {v: sum(1 for e in scores if e.get("verdict") == v)
+                for v in ("promote", "rollback", "hold")}
+    promotions = [e for e in rows if e.get("kind") == "canary_promote"]
+    rollbacks = [e for e in rows if e.get("kind") == "canary_rollback"]
+    lines += [
+        "",
+        f"{len(reloads)} hot-reload(s), {len(rejected)} rejected "
+        f"candidate(s); {len(scores)} shadow score(s) "
+        f"({verdicts['promote']} promote, {verdicts['rollback']} "
+        f"rollback, {verdicts['hold']} hold verdicts) -> "
+        f"{len(promotions)} promotion(s), {len(rollbacks)} "
+        "rollback(s) actuated."]
+    # the timeline keeps every actuation/rejection but compresses the
+    # hold verdicts (a steady canary is one count, not hundreds of
+    # rows)
+    shown = [e for e in rows if not (
+        e.get("kind") == "canary_score" and e.get("verdict") == "hold")]
+    if shown:
+        lines += ["", "| time | host | kind | step | detail |",
+                  "|---|---|---|---|---|"]
+        for e in shown:
+            kind = e.get("kind", "?")
+            step = e.get("step", "-")
+            if kind == "serve_reload":
+                detail = (f"{e.get('previous_step', '?')} -> "
+                          f"{e.get('step', '?')} in "
+                          f"{_fmt_num(e.get('duration_ms'))} ms "
+                          f"({e.get('verification', '?')})")
+            elif kind == "serve_reload_rejected":
+                detail = (f"reason={e.get('reason', '?')}: "
+                          f"{e.get('detail', '')}"[:120])
+            elif kind == "canary_score":
+                detail = (f"{e.get('verdict', '?')}: "
+                          f"p99_ratio={_fmt_num(e.get('p99_ratio'))} "
+                          f"err={_fmt_num(e.get('error_rate'))} "
+                          f"drift={_fmt_num(e.get('drift'))}")
+                step = (f"{e.get('incumbent_step', '?')}/"
+                        f"{e.get('canary_step', '?')}")
+            elif kind == "canary_promote":
+                detail = (f"{e.get('previous_step', '?')} -> "
+                          f"{e.get('step', '?')} after streak "
+                          f"{e.get('streak', '?')} "
+                          f"(reload_ok={e.get('reload_ok', '?')})")
+            elif kind == "canary_rollback":
+                detail = (f"{e.get('from_step', '?')} -> "
+                          f"{e.get('to_step', '?')} "
+                          f"(reload_ok={e.get('reload_ok', '?')})")
+                step = e.get("to_step", "-")
+            else:
+                detail = "-"
+            lines.append(
+                f"| {_ts(e.get('time'))} | {e.get('host', '-')} "
+                f"| {kind} | {step} | {detail} |")
+    if rejected:
+        reasons: Dict[str, int] = {}
+        for e in rejected:
+            reasons[e.get("reason", "?")] = reasons.get(
+                e.get("reason", "?"), 0) + 1
+        lines += ["",
+                  "Rejections by reason: " + ", ".join(
+                      f"{k}×{n}" for k, n in sorted(
+                          reasons.items(), key=lambda kv: -kv[1]))
+                  + " — a rejected candidate leaves the old params "
+                    "serving (eksml_tpu/serve/reload.py)."]
+    return lines
+
+
 def _attribution_section(logdir: str,
                          attribution: Optional[str]) -> List[str]:
     path = attribution or os.path.join(logdir, "profile",
@@ -841,6 +931,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.extend(_goodput_section(logdir))
     lines.append("")
     lines.extend(_autoscale_section(logdir))
+    lines.append("")
+    lines.extend(_deployments_section(events))
     lines.append("")
     lines.extend(_slow_steps_section(logdir))
     lines.append("")

@@ -20,12 +20,28 @@ artifact attributes tail latency to a phase, and the post-run
 ``/healthz`` scrape pins the engine's compile counters — the banked
 proof that the request path compiled NOTHING after warmup.
 
+**Record / replay / shadow** (the canary-scoring harness): ``--record``
+banks the request DISTRIBUTION (seed + per-request shapes — the
+regenerable form, kilobytes not gigabytes) so the exact same traffic
+replays later; ``--replay BANK --shadow --canary-url URL`` mirrors
+every banked request at both the incumbent and the canary and scores
+the canary on three axes — latency p99 ratio, error rate, and
+detection-output drift (pre-threshold ``raw_top`` head outputs, so
+drift is exactly 0 for identical params and nonzero for different
+ones even when neither side clears the score threshold).  The score
+artifact banks as ``artifacts/shadow_r<N>.json``; the promotion
+controller (``tools/eksml_operator.py --promote``) consumes the same
+``replay_shadow`` call to gate promote-vs-rollback.
+
 Usage::
 
     python tools/serve_loadtest.py --url http://127.0.0.1:8081 \\
         --requests 200 --concurrency 8 --bank
     python tools/serve_loadtest.py --port-file /tmp/serve.port \\
         --mode open --rate 50 --requests 500 --out artifacts/serve_r2.json
+    python tools/serve_loadtest.py --record /tmp/bank.json --requests 100
+    python tools/serve_loadtest.py --url http://stable:8081 \\
+        --replay /tmp/bank.json --shadow --canary-url http://canary:8081
 """
 
 from __future__ import annotations
@@ -69,10 +85,12 @@ def gen_image(seed: int, idx: int, sizes: List[Tuple[int, int]]
 
 
 def post_predict(url: str, image: np.ndarray, timeout: float = 120.0,
-                 score_thresh: Optional[float] = None) -> Dict:
+                 score_thresh: Optional[float] = None,
+                 raw_topk: int = 0) -> Dict:
     """One request; returns the decoded response with ``_latency_ms``
     (client-observed) added.  Raises ``urllib.error.HTTPError`` on a
-    non-2xx answer."""
+    non-2xx answer.  ``raw_topk`` asks the server for its
+    pre-threshold top-k raw head outputs (the drift signal)."""
     payload: Dict = {
         "image_b64": base64.b64encode(image.tobytes()).decode("ascii"),
         "shape": list(image.shape),
@@ -80,6 +98,8 @@ def post_predict(url: str, image: np.ndarray, timeout: float = 120.0,
     }
     if score_thresh is not None:
         payload["score_thresh"] = score_thresh
+    if raw_topk:
+        payload["raw_topk"] = int(raw_topk)
     body = json.dumps(payload).encode("utf-8")
     req = urllib.request.Request(
         url.rstrip("/") + "/v1/predict", data=body,
@@ -142,8 +162,13 @@ def _pct(values: List[float], q: float) -> float:
 def run_load(url: str, requests: int, concurrency: int,
              mode: str = "closed", rate: float = 0.0, seed: int = 0,
              sizes: str = DEFAULT_SIZES,
-             timeout: float = 120.0) -> Dict:
-    """Drive the load and fold the records into the artifact dict."""
+             timeout: float = 120.0,
+             keep_records: bool = False) -> Dict:
+    """Drive the load and fold the records into the artifact dict.
+    ``keep_records=True`` adds the raw per-request records (t_wall +
+    params_step included) — the hot-reload chaos rung joins them
+    against the ``serve_reload`` flight event to prove the swap
+    boundary; banked artifacts stay summary-only."""
     size_list = [tuple(int(d) for d in s.split("x"))
                  for s in sizes.split(",") if s]
     records: List[Dict] = []
@@ -183,6 +208,7 @@ def run_load(url: str, requests: int, concurrency: int,
         with rec_lock:
             records.append({
                 "idx": idx,
+                "t_wall": time.time(),
                 "total_ms": resp["_latency_ms"],
                 "phases": {k: resp.get("timings_ms", {}).get(k)
                            for k in PHASES},
@@ -190,6 +216,10 @@ def run_load(url: str, requests: int, concurrency: int,
                 "batch_fill": resp.get("batch_fill"),
                 "batch_rung": resp.get("batch_rung"),
                 "detections": len(resp.get("detections", ())),
+                # checkpoint that served this request — the hot-reload
+                # chaos rung joins these against the serve_reload
+                # flight event to prove the flip boundary
+                "params_step": resp.get("params_step"),
             })
 
     def worker() -> None:
@@ -270,20 +300,206 @@ def run_load(url: str, requests: int, concurrency: int,
         "batch_occupancy_mean": round(float(np.mean(fills)), 3)
         if fills else None,
         "slowest": slowest,
+        **({"records": records} if keep_records else {}),
     }
 
 
-def next_bank_path(artifacts_dir: str) -> str:
-    """First free ``serve_r<N>.json`` slot."""
+def build_bank(seed: int, sizes: str, requests: int) -> Dict:
+    """The recorded request distribution in regenerable form: seed +
+    per-request shapes, not pixel payloads — the bank stays kilobytes
+    and ``gen_image(seed, idx, [(h, w)])`` reproduces every image
+    bit-exactly at replay time."""
+    size_list = [tuple(int(d) for d in s.split("x"))
+                 for s in sizes.split(",") if s]
+    return {
+        "kind": "serve_request_bank",
+        "seed": int(seed),
+        "sizes": sizes,
+        "requests": [
+            {"idx": i,
+             "h": size_list[i % len(size_list)][0],
+             "w": size_list[i % len(size_list)][1]}
+            for i in range(requests)],
+        "recorded_at": _utcnow(),
+    }
+
+
+def bank_image(bank: Dict, row: Dict) -> np.ndarray:
+    """Regenerate one banked request's image bit-exactly."""
+    return gen_image(int(bank["seed"]), int(row["idx"]),
+                     [(int(row["h"]), int(row["w"]))])
+
+
+def detection_drift(a: Dict, b: Dict) -> float:
+    """Output disagreement between two responses for ONE request,
+    in [0, 1]; exactly 0.0 when the params are identical.
+
+    Primary signal: the pre-threshold ``raw_top`` head outputs — per
+    rank, a class disagreement counts 1.0 and a class match counts
+    the score delta.  This stays nonzero for different params even
+    when both checkpoints emit zero above-threshold detections (the
+    degenerate case where a detections-based metric would
+    silently report "no drift" between arbitrary params).  Fallback
+    (no ``raw_top`` in the responses): greedy same-class IoU >= 0.5
+    matching over the thresholded detections, drift = 1 - 2m/(na+nb).
+    """
+    ra, rb = a.get("raw_top"), b.get("raw_top")
+    if ra and rb:
+        k = min(len(ra["scores"]), len(rb["scores"]))
+        if k == 0:
+            return 0.0
+        per_rank = [
+            1.0 if ra["classes"][i] != rb["classes"][i]
+            else min(1.0, abs(float(ra["scores"][i])
+                              - float(rb["scores"][i])))
+            for i in range(k)]
+        return float(np.mean(per_rank))
+    da, db = a.get("detections", []), b.get("detections", [])
+    if not da and not db:
+        return 0.0
+
+    def iou(b1, b2) -> float:
+        x0 = max(b1[0], b2[0]); y0 = max(b1[1], b2[1])  # noqa: E702
+        x1 = min(b1[2], b2[2]); y1 = min(b1[3], b2[3])  # noqa: E702
+        inter = max(0.0, x1 - x0) * max(0.0, y1 - y0)
+        a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+        a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+        return inter / max(a1 + a2 - inter, 1e-9)
+
+    unmatched = list(range(len(db)))
+    matches = 0
+    for d in da:
+        best, best_iou = None, 0.5
+        for j in unmatched:
+            if d["class_id"] != db[j]["class_id"]:
+                continue
+            v = iou(d["box"], db[j]["box"])
+            if v >= best_iou:
+                best, best_iou = j, v
+        if best is not None:
+            unmatched.remove(best)
+            matches += 1
+    return 1.0 - 2.0 * matches / (len(da) + len(db))
+
+
+def replay_shadow(bank: Dict, url: str, canary_url: str,
+                  timeout: float = 120.0, raw_topk: int = 16,
+                  score_thresh: Optional[float] = None,
+                  concurrency: int = 4) -> Dict:
+    """Mirror the banked traffic at incumbent AND canary; score the
+    canary on latency p99 ratio, error rate, and output drift.
+
+    Each worker sends one request to both servers back-to-back (the
+    pair sees the same queue conditions, so the p99 ratio compares
+    like with like), then diffs the outputs.  The score dict is what
+    ``promotion_verdict`` (tools/eksml_operator.py) gates on."""
+    rows = bank["requests"]
+    rec_lock = threading.Lock()
+    inc_lat: List[float] = []
+    can_lat: List[float] = []
+    drifts: List[float] = []
+    inc_errors: List[str] = []
+    can_errors: List[str] = []
+    inc_steps: set = set()
+    can_steps: set = set()
+    work: "queue.Queue" = queue.Queue()
+    for row in rows:
+        work.put(row)
+
+    def one(row: Dict) -> None:
+        img = bank_image(bank, row)
+        try:
+            a = post_predict(url, img, timeout=timeout,
+                             score_thresh=score_thresh,
+                             raw_topk=raw_topk)
+        except Exception as e:  # noqa: BLE001 — scored, not fatal
+            with rec_lock:
+                inc_errors.append(f"req {row['idx']}: {e!r}")
+            return
+        try:
+            b = post_predict(canary_url, img, timeout=timeout,
+                             score_thresh=score_thresh,
+                             raw_topk=raw_topk)
+        except Exception as e:  # noqa: BLE001 — scored, not fatal
+            with rec_lock:
+                inc_lat.append(a["_latency_ms"])
+                can_errors.append(f"req {row['idx']}: {e!r}")
+            return
+        d = detection_drift(a, b)
+        with rec_lock:
+            inc_lat.append(a["_latency_ms"])
+            can_lat.append(b["_latency_ms"])
+            drifts.append(d)
+            inc_steps.add(a.get("params_step"))
+            can_steps.add(b.get("params_step"))
+
+    def worker() -> None:
+        while True:
+            try:
+                row = work.get_nowait()
+            except queue.Empty:
+                return
+            one(row)
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"shadow-{i}")
+               for i in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    inc_p99, can_p99 = _pct(inc_lat, 99), _pct(can_lat, 99)
+    scored = len(drifts)
+    return {
+        "kind": "serve_shadow_score",
+        "bank_seed": bank.get("seed"),
+        "requests": len(rows),
+        "scored": scored,
+        "incumbent": {
+            "url": url,
+            "errors": len(inc_errors),
+            "error_samples": inc_errors[:3],
+            "params_steps": sorted(
+                s for s in inc_steps if s is not None),
+            "latency_ms": {"p50": round(_pct(inc_lat, 50), 3),
+                           "p99": round(inc_p99, 3)},
+        },
+        "canary": {
+            "url": canary_url,
+            "errors": len(can_errors),
+            "error_samples": can_errors[:3],
+            "params_steps": sorted(
+                s for s in can_steps if s is not None),
+            "latency_ms": {"p50": round(_pct(can_lat, 50), 3),
+                           "p99": round(can_p99, 3)},
+        },
+        # the three gate axes (promotion_verdict reads exactly these)
+        "p99_ratio": round(can_p99 / inc_p99, 4) if inc_p99 > 0
+        else None,
+        "canary_error_rate": round(
+            len(can_errors) / max(len(rows), 1), 4),
+        "drift": {
+            "mean": round(float(np.mean(drifts)), 6) if drifts else None,
+            "p99": round(_pct(drifts, 99), 6) if drifts else None,
+            "max": round(max(drifts), 6) if drifts else None,
+        },
+        "scored_at": _utcnow(),
+    }
+
+
+def next_bank_path(artifacts_dir: str, prefix: str = "serve") -> str:
+    """First free ``<prefix>_r<N>.json`` slot."""
     taken = set()
-    for p in glob.glob(os.path.join(artifacts_dir, "serve_r*.json")):
-        m = re.match(r"serve_r(\d+)\.json$", os.path.basename(p))
+    for p in glob.glob(os.path.join(artifacts_dir,
+                                    f"{prefix}_r*.json")):
+        m = re.match(prefix + r"_r(\d+)\.json$", os.path.basename(p))
         if m:
             taken.add(int(m.group(1)))
     n = 1
     while n in taken:
         n += 1
-    return os.path.join(artifacts_dir, f"serve_r{n}.json")
+    return os.path.join(artifacts_dir, f"{prefix}_r{n}.json")
 
 
 def main(argv=None) -> int:
@@ -314,7 +530,30 @@ def main(argv=None) -> int:
     p.add_argument("--note", default=None,
                    help="free-text provenance recorded in the "
                         "artifact (geometry, hardware, caveats)")
+    p.add_argument("--record", default=None, metavar="PATH",
+                   help="bank the request distribution (seed + "
+                        "shapes) here and exit — no server needed")
+    p.add_argument("--replay", default=None, metavar="BANK",
+                   help="replay a recorded bank instead of generating "
+                        "fresh traffic")
+    p.add_argument("--shadow", action="store_true",
+                   help="with --replay: mirror each request at "
+                        "--canary-url too and score the canary "
+                        "(latency p99 ratio, error rate, drift)")
+    p.add_argument("--canary-url", default=None,
+                   help="canary base URL for --shadow scoring")
+    p.add_argument("--raw-topk", type=int, default=16,
+                   help="pre-threshold top-k raw outputs per request "
+                        "for the drift signal [%(default)s]")
     args = p.parse_args(argv)
+
+    if args.record:
+        bank = build_bank(args.seed, args.sizes, args.requests)
+        os.makedirs(os.path.dirname(args.record) or ".", exist_ok=True)
+        atomic_write_json(args.record, bank)
+        print(f"recorded {len(bank['requests'])} request(s) -> "
+              f"{args.record}")
+        return 0
 
     if args.url:
         url = args.url
@@ -329,6 +568,39 @@ def main(argv=None) -> int:
         p.error("need --url or --port-file")
     if args.mode == "open" and args.rate <= 0:
         p.error("--mode open needs --rate > 0")
+
+    if args.shadow:
+        if not (args.replay and args.canary_url):
+            p.error("--shadow needs --replay BANK and --canary-url")
+        with open(args.replay) as f:
+            bank = json.load(f)
+        wait_ready(url, budget=args.wait_ready)
+        wait_ready(args.canary_url, budget=args.wait_ready)
+        score = replay_shadow(bank, url, args.canary_url,
+                              timeout=args.timeout,
+                              raw_topk=args.raw_topk,
+                              concurrency=args.concurrency)
+        if args.note:
+            score["note"] = args.note
+        print(json.dumps(score, indent=1))
+        out = args.out
+        if out is None and args.bank:
+            out = next_bank_path(os.path.join(REPO, "artifacts"),
+                                 prefix="shadow")
+        if out:
+            os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+            atomic_write_json(out, score)
+            print(f"banked {out}", file=sys.stderr)
+        return 0 if score["canary_error_rate"] == 0 else 1
+
+    if args.replay:
+        # a bank IS (seed, sizes, count) — replaying without --shadow
+        # is run_load over the exact recorded distribution
+        with open(args.replay) as f:
+            bank = json.load(f)
+        args.seed = int(bank["seed"])
+        args.sizes = bank["sizes"]
+        args.requests = len(bank["requests"])
 
     health = wait_ready(url, budget=args.wait_ready)
     artifact = run_load(url, args.requests, args.concurrency,
