@@ -263,6 +263,19 @@ def main(argv=None):
                    help="model axis size for --sharding tensor/2d "
                         "(0 = all devices of one slice under tensor; "
                         "2d needs it set explicitly)")
+    p.add_argument("--num-slices", type=int, default=0,
+                   help="slice count for the measured mesh "
+                        "(TPU.NUM_SLICES); 0 = auto — hardware slice "
+                        "groups always win, the flag only pins "
+                        "emulated/CPU splits [%(default)s]")
+    p.add_argument("--exchange", default="flat",
+                   choices=["flat", "hierarchical"],
+                   help="cross-slice gradient exchange "
+                        "(TRAIN.SHARDING.EXCHANGE): hierarchical = "
+                        "in-slice reduce-scatter on ICI, DCN "
+                        "all-reduce of the partials, in-slice "
+                        "all-gather back; inert at one slice "
+                        "[%(default)s]")
     p.add_argument("--prefetch", type=int, default=-1,
                    choices=(-1, 0, 1),
                    help="input-pipeline A/B: -1 = one device-resident "
@@ -372,11 +385,20 @@ def _run_with_remat(args, diag: dict) -> None:
     15.75G HBM at 1344px/batch-4."""
     import traceback
 
+    # the retry run happens OUTSIDE the except block: run() reaches
+    # the sharded step's collectives (storage_grads), and a collective
+    # under an exception handler is a host-local entry the
+    # collective-order checker rightly rejects — only the raising host
+    # would enter it
+    retry = False
     try:
         run(args, diag)
     except Exception as e:  # noqa: BLE001
         if not (_is_hbm_oom(e) and not args.remat):
-            raise
+            # bench is a per-host measurement CLI: a raise here ends
+            # THIS host's run and its JSON line records the failure —
+            # no fleet is left blocking in the retry's collectives
+            raise  # eksml-lint: disable=collective-order
         print("bench: HBM OOM at this operating point; retrying "
               "with TRAIN.REMAT=True", file=sys.stderr)
         # snapshot the failure, then DROP the traceback before the
@@ -389,6 +411,8 @@ def _run_with_remat(args, diag: dict) -> None:
         args.remat = True
         diag["remat_fallback"] = True
         diag["pre_remat_error"] = err_msg.splitlines()[0][:200]
+        retry = True
+    if retry:
         run(args, diag)
 
 
@@ -608,6 +632,7 @@ def run(args, diag: dict) -> None:
                                           "replicated")
     cfg.TRAIN.SHARDING.FSDP_AXIS_SIZE = getattr(args, "fsdp_axis", 0)
     cfg.TRAIN.SHARDING.MODEL_AXIS_SIZE = getattr(args, "model_axis", 0)
+    cfg.TRAIN.SHARDING.EXCHANGE = getattr(args, "exchange", "flat")
     cfg.PREPROC.MAX_SIZE = size
     cfg.PREPROC.TRAIN_SHORT_EDGE_SIZE = (size, size)
     cfg.update_args(args.config)
@@ -668,9 +693,12 @@ def run(args, diag: dict) -> None:
 
         # the plan must see the real slice topology: with the config
         # default NUM_SLICES=1, --fsdp-axis 0 on multislice hardware
-        # would resolve to ALL devices and straddle the DCN hop
+        # would resolve to ALL devices and straddle the DCN hop.
+        # Hardware slice groups always win; --num-slices only pins
+        # emulated/CPU splits (virtual devices carry no slice info)
         groups = slice_groups(devices)
-        num_slices = len(groups) if groups else 1
+        num_slices = (len(groups) if groups
+                      else max(1, getattr(args, "num_slices", 0)))
         if num_slices > 1:
             cfg.freeze(False)
             cfg.TPU.NUM_SLICES = num_slices
@@ -680,6 +708,11 @@ def run(args, diag: dict) -> None:
                           num_slices=num_slices)
         plan = ShardingPlan.from_config(cfg, mesh)
         diag["sharding"] = plan.describe()
+        # consumers must never have to assume one slice: the JSON
+        # line (and every banked artifact derived from it) carries
+        # the slice topology the step actually ran on
+        diag["num_slices"] = num_slices
+        diag["slice_devices"] = n_dev // max(1, num_slices)
 
     # input-pipeline A/B (--prefetch): a small pool of DISTINCT host
     # batches cycled through the step loop, so transfer modes measure
@@ -895,7 +928,8 @@ def run(args, diag: dict) -> None:
                 mesh_shape=(dict(plan.mesh.shape)
                             if plan is not None else {}),
                 precision=str(cfg.TRAIN.PRECISION),
-                num_slices=int(cfg.TPU.NUM_SLICES))
+                num_slices=int(cfg.TPU.NUM_SLICES),
+                exchange=str(cfg.TRAIN.SHARDING.EXCHANGE))
             diag["predicted_step_time_ms"] = \
                 pred["predicted_step_time_ms"]
             diag["predicted_sections_ms"] = pred["sections_ms"]

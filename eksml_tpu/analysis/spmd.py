@@ -89,6 +89,10 @@ _SEED_COLLECTIVE_DEFS = (
     ("eksml_tpu/parallel/collectives.py", "assert_replicas_in_sync"),
     ("eksml_tpu/utils/checkpoint.py", "CheckpointManager.save"),
     ("eksml_tpu/utils/checkpoint.py", "CheckpointManager.restore"),
+    # the hierarchical exchange's staged sharding constraints compile
+    # to the ICI-RS / DCN-AR / ICI-AG collective schedule — ordering
+    # around a caller of storage_grads is ordering around collectives
+    ("eksml_tpu/parallel/sharding.py", "ShardingPlan.storage_grads"),
 )
 #: Calls whose result differs per host (the repo's own wrappers too).
 _DIVERGENT_CALLS = ("process_index", "is_coordinator")

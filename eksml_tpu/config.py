@@ -264,11 +264,21 @@ TELEMETRY_DEFAULTS = dict(
 #   the output-feature/last dim), "2d" (both), "replicated", or a
 #   literal PartitionSpec tuple.  MUST end with a catch-all.  () =
 #   the strategy's defaults (sharding.DEFAULT_RULES).
+# - EXCHANGE: how gradients cross slices when TPU.NUM_SLICES > 1.
+#   "flat" = one ring over every replica (the legacy layout — the
+#   whole all-reduce is bounded by the slowest link, DCN once it
+#   spans slices).  "hierarchical" = plan_mesh emits an explicit
+#   leading "slice" mesh axis and storage_grads stages the exchange:
+#   reduce-scatter on ICI within each slice, all-reduce of the
+#   1/per-slice partials over DCN, all-gather back on ICI — only one
+#   slice-reduced copy of the gradients ever rides the thin DCN NIC.
+#   No effect at NUM_SLICES=1 (single slice has no DCN hop).
 SHARDING_DEFAULTS = dict(
     STRATEGY="replicated",
     FSDP_AXIS_SIZE=0,
     MODEL_AXIS_SIZE=0,
     RULES=(),
+    EXCHANGE="flat",
 )
 
 # Span tracing + on-demand profiling knobs (telemetry/tracing.py),

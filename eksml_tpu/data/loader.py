@@ -182,9 +182,25 @@ class DetectionLoader:
                  with_masks: bool = True, prefetch: int = 4,
                  gt_mask_size: int = 56,
                  num_workers: Optional[int] = None,
-                 ledger_dir: Optional[str] = None):
+                 ledger_dir: Optional[str] = None,
+                 num_slices: int = 1):
         assert len(records) > 0, "empty dataset"
-        self.records = records[host_id::num_hosts]
+        num_slices = max(1, int(num_slices))
+        if num_slices > 1 and num_hosts % num_slices == 0:
+            # per-slice data sharding: hosts are slice-major (the
+            # build_mesh device order), so slice s owns the strided
+            # shard records[s::num_slices] and its hosts restride
+            # within it — the union over all hosts is exactly the
+            # single-slice num_hosts shard set (no record read twice,
+            # none dropped), but each host's reads stay confined to
+            # its own slice's shard of the schedule
+            hosts_per_slice = num_hosts // num_slices
+            slice_id = host_id // hosts_per_slice
+            local_id = host_id % hosts_per_slice
+            self.records = records[slice_id::num_slices][
+                local_id::hosts_per_slice]
+        else:
+            self.records = records[host_id::num_hosts]
         if not self.records:  # more hosts than records (tiny smoke runs)
             self.records = records[:1]
         self.cfg = cfg

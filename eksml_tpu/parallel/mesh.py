@@ -229,7 +229,20 @@ def build_mesh(mesh_shape: Sequence[int] = (),
             raise ValueError(
                 f"multi-slice mesh must cover all {n} devices "
                 f"(shape {tuple(mesh_shape)} covers {need})")
-        if mesh_shape[0] % num_slices:
+        if axis_names[0] == "slice":
+            # explicit slice axis (the hierarchical-exchange layout,
+            # sharding.plan_mesh): the leading axis IS the slice
+            # decomposition, so it must equal the slice count exactly
+            # — slice-major device order then puts each mesh slice on
+            # one hardware slice and every trailing axis (data/fsdp/
+            # model) stays inside it by construction
+            if mesh_shape[0] != num_slices:
+                raise ValueError(
+                    f"slice axis size {mesh_shape[0]} must equal the "
+                    f"slice count ({num_slices}): the 'slice' mesh "
+                    f"axis is the DCN decomposition itself and cannot "
+                    f"split or merge hardware slices")
+        elif mesh_shape[0] % num_slices:
             # this is also what keeps the trailing (fsdp/model) axes
             # INSIDE one slice: with slice-major device order, each
             # data index owns one contiguous block of trailing-axes

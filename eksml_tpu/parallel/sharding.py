@@ -59,6 +59,15 @@ the ``fsdp`` axis between ``data`` and ``model`` and validating the
 axis sizes (and for ``2d`` their product) against the per-slice
 device count — the fsdp/model all-gathers are per-step traffic and
 must ride ICI, never a DCN hop.
+
+At ``TPU.NUM_SLICES > 1`` with ``TRAIN.SHARDING.EXCHANGE=
+"hierarchical"`` the sharded strategies grow a leading ``slice``
+mesh axis and ``storage_grads`` stages the gradient exchange —
+reduce-scatter on ICI within each slice, all-reduce of the
+1/per-slice partials over **DCN**, all-gather back on ICI — so the
+thin inter-slice NIC only ever carries one slice-reduced copy of
+the gradients instead of bounding a flat all-replica ring
+(TPU Multislice / MegaScale-style hierarchical reduction).
 """
 
 from __future__ import annotations
@@ -78,6 +87,13 @@ from eksml_tpu.parallel.mesh import divisors as _divisors
 log = logging.getLogger(__name__)
 
 STRATEGIES = ("replicated", "fsdp", "tensor", "2d")
+
+#: gradient-exchange layouts across slices (TRAIN.SHARDING.EXCHANGE).
+#: "flat" prices/runs one ring over every replica; "hierarchical"
+#: stages it as ICI reduce-scatter within each slice, DCN all-reduce
+#: of the 1/per-slice partials, ICI all-gather back — only matters at
+#: TPU.NUM_SLICES > 1 (a single slice has no DCN hop to protect).
+EXCHANGES = ("flat", "hierarchical")
 
 #: rule actions (besides a literal PartitionSpec tuple)
 REPLICATED = "replicated"
@@ -377,6 +393,13 @@ def plan_mesh(cfg, n_devices: Optional[int] = None
     so a shard group may never straddle a DCN hop.  An explicit
     operator ``TPU.MESH_SHAPE`` always wins (but must name the axes
     the strategy shards over).
+
+    Under ``EXCHANGE="hierarchical"`` at ``TPU.NUM_SLICES > 1`` the
+    sharded strategies additionally get a leading ``slice`` mesh axis
+    sized to the slice count (the data axis then counts per-slice
+    replicas), which is what lets ``ShardingPlan.storage_grads``
+    stage the gradient exchange instead of pricing one flat ring at
+    the DCN link.
     """
     knobs = sharding_knobs(cfg)
     strategy = str(knobs["STRATEGY"])
@@ -384,6 +407,11 @@ def plan_mesh(cfg, n_devices: Optional[int] = None
         raise ValueError(
             f"TRAIN.SHARDING.STRATEGY={strategy!r} is not one of "
             f"{STRATEGIES}")
+    exchange = str(knobs.get("EXCHANGE", "flat"))
+    if exchange not in EXCHANGES:
+        raise ValueError(
+            f"TRAIN.SHARDING.EXCHANGE={exchange!r} is not one of "
+            f"{EXCHANGES}")
     shape = tuple(int(s) for s in cfg.TPU.MESH_SHAPE)
     axes = tuple(cfg.TPU.MESH_AXES)
     if strategy == "replicated":
@@ -448,6 +476,20 @@ def plan_mesh(cfg, n_devices: Optional[int] = None
             f"shard group must fit inside one slice so its collectives "
             f"never straddle a DCN hop; the axis product must be one "
             f"of {_divisors(per_slice)}")
+    if exchange == "hierarchical" and num_slices > 1:
+        # explicit leading "slice" axis: the DCN decomposition becomes
+        # a mesh dimension the plan can stage gradients over (ICI
+        # reduce-scatter in-slice, DCN all-reduce of partials, ICI
+        # all-gather back — ShardingPlan.storage_grads).  The data
+        # axis then counts PER-SLICE replicas; slice-major device
+        # order (build_mesh) puts each mesh slice on one hardware
+        # slice so the trailing axes never straddle the DCN hop.
+        axes = ("slice",) + tuple(a for a in axes if a != "slice")
+        return (num_slices,) + tuple(
+            per_slice // (f * m) if a == "data"
+            else f if a == "fsdp"
+            else m if a == "model" else 1
+            for a in axes[1:]), axes
     # size axes BY NAME: an operator MESH_AXES ordering the fsdp axis
     # anywhere but index 1 must still get its size (positional sizing
     # silently left fsdp at 1 — a fully-replicated run claiming fsdp)
@@ -465,15 +507,21 @@ class ShardingPlan:
     """
 
     def __init__(self, strategy: str, mesh: Mesh, rules=(),
-                 fsdp_axis: str = "fsdp", model_axis: str = "model"):
+                 fsdp_axis: str = "fsdp", model_axis: str = "model",
+                 exchange: str = "flat"):
         if strategy not in STRATEGIES:
             raise ValueError(
                 f"unknown sharding strategy {strategy!r}; valid: "
                 f"{STRATEGIES} (TRAIN.SHARDING.STRATEGY)")
+        if exchange not in EXCHANGES:
+            raise ValueError(
+                f"unknown gradient exchange {exchange!r}; valid: "
+                f"{EXCHANGES} (TRAIN.SHARDING.EXCHANGE)")
         self.strategy = strategy
         self.mesh = mesh
         self.fsdp_axis = fsdp_axis
         self.model_axis = model_axis
+        self.exchange = exchange
         mesh_axes = dict(mesh.shape)
         if strategy in ("fsdp", "2d") and fsdp_axis not in mesh_axes:
             raise ValueError(
@@ -489,9 +537,13 @@ class ShardingPlan:
                 "plan_mesh(cfg) (train.py does)")
         self.axis_size = int(mesh_axes.get(fsdp_axis, 1))
         self.model_axis_size = int(mesh_axes.get(model_axis, 1))
+        #: >1 only on a hierarchical-exchange mesh (plan_mesh emits
+        #: the explicit "slice" axis); 1 everywhere else, so every
+        #: existing mesh behaves exactly as before
+        self.slice_axis_size = int(mesh_axes.get("slice", 1))
         self.rules = validate_rules(rules or DEFAULT_RULES[strategy])
-        batch_axes = tuple(a for a in ("data", fsdp_axis, model_axis)
-                           if a in mesh_axes)
+        batch_axes = tuple(a for a in ("slice", "data", fsdp_axis,
+                                       model_axis) if a in mesh_axes)
         #: batch rows split over EVERY mesh axis — each chip carries
         #: its own rows under every strategy (the strategies change
         #: the STORAGE layout, never the replica count), which is
@@ -505,7 +557,8 @@ class ShardingPlan:
     def from_config(cls, cfg, mesh: Mesh) -> "ShardingPlan":
         k = sharding_knobs(cfg)
         return cls(str(k["STRATEGY"]), mesh,
-                   rules=tuple(k["RULES"] or ()))
+                   rules=tuple(k["RULES"] or ()),
+                   exchange=str(k.get("EXCHANGE", "flat")))
 
     # -- specs / shardings --------------------------------------------
 
@@ -580,13 +633,62 @@ class ShardingPlan:
         return jax.lax.with_sharding_constraint(params,
                                                 self.replicated())
 
+    def exchange_specs(self, tree):
+        """Intermediate PartitionSpec pytree of the hierarchical
+        exchange: each gradient leaf sharded over EVERY in-slice mesh
+        axis jointly (on the largest evenly-divisible dim) and
+        replicated over ``slice``.  Constraining grads here first
+        makes the partitioner reduce within each slice on ICI
+        (reduce-scatter to 1/per-slice shards) and sum only those
+        partials across slices on DCN; the follow-up constraint back
+        to the storage layout is the in-slice all-gather.  Leaves
+        with no dim divisible by the in-slice device product fall
+        back to their storage spec (= the flat exchange for that
+        leaf — correctness never depends on the staging)."""
+        mesh_axes = dict(self.mesh.shape)
+        inner = tuple(a for a in ("data", self.fsdp_axis,
+                                  self.model_axis)
+                      if int(mesh_axes.get(a, 1)) > 1)
+        group = 1
+        for a in inner:
+            group *= int(mesh_axes[a])
+        storage = self.specs(tree)
+        if not inner or group <= 1:
+            return storage
+
+        def one(leaf, spec):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if len(shape) == 0 or int(np.prod(shape)) == 1:
+                return spec
+            dim = _auto_axis_dim(shape, group)
+            if dim is None:
+                return spec
+            entries: List[Optional[Any]] = [None] * len(shape)
+            entries[dim] = inner if len(inner) > 1 else inner[0]
+            return _spec_from_entries(entries)
+
+        return jax.tree.map(one, tree, storage)
+
     def storage_grads(self, grads):
         """Constrain gradients back to the storage layout (XLA
         lowers the psum+slice to reduce-scatters on the storage
         axes), so the optimizer update runs on shards.  Identity
-        under ``replicated``."""
+        under ``replicated``.
+
+        Under ``exchange="hierarchical"`` on a multi-slice mesh the
+        constraint is staged: first to :meth:`exchange_specs` (ICI
+        reduce-scatter within each slice + DCN all-reduce of the
+        1/per-slice partials), then to the storage layout (ICI
+        all-gather back) — the gradient values are identical either
+        way (constraints never change values, only layouts), so loss
+        streams stay bit-compatible with the flat exchange."""
         if self.strategy == "replicated":
             return grads
+        if self.exchange == "hierarchical" and self.slice_axis_size > 1:
+            inter = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                self.exchange_specs(grads))
+            grads = jax.lax.with_sharding_constraint(grads, inter)
         return jax.lax.with_sharding_constraint(grads,
                                                 self.shardings(grads))
 
@@ -637,14 +739,19 @@ class ShardingPlan:
 
     def describe(self) -> str:
         """One-line summary for logs and bench diagnostics."""
+        # slices only show when the mesh actually carries the axis —
+        # every single-slice plan keeps its historical string
+        extra = (f", slices={self.slice_axis_size}, "
+                 f"exchange={self.exchange}"
+                 if self.slice_axis_size > 1 else "")
         if self.strategy == "fsdp":
             return (f"fsdp(axis={self.axis_size}, "
-                    f"rules={len(self.rules)})")
+                    f"rules={len(self.rules)}{extra})")
         if self.strategy == "tensor":
             return (f"tensor(model={self.model_axis_size}, "
-                    f"rules={len(self.rules)})")
+                    f"rules={len(self.rules)}{extra})")
         if self.strategy == "2d":
             return (f"2d(fsdp={self.axis_size}, "
                     f"model={self.model_axis_size}, "
-                    f"rules={len(self.rules)})")
+                    f"rules={len(self.rules)}{extra})")
         return self.strategy

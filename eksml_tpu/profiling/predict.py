@@ -126,6 +126,25 @@ def _ring_factor(opcode: str, k: int) -> float:
     return float(k - 1) / k
 
 
+def hierarchical_allreduce_seconds(nbytes: float, k: int,
+                                   slice_devices: int,
+                                   ici: float, dcn: float) -> float:
+    """Three-phase price of one cross-slice gradient all-reduce under
+    the hierarchical exchange (TRAIN.SHARDING.EXCHANGE=
+    "hierarchical"): reduce-scatter over the ``per`` in-slice devices
+    on ICI, all-reduce of the 1/per-sized partials over the ``s =
+    k // per`` slices on DCN, all-gather back on ICI.  Strictly below
+    the flat ring (``2(k-1)/k`` of the payload at DCN speed) whenever
+    per > 1 — the full gradient never rides the thin link, only one
+    slice-reduced copy does."""
+    per = max(1, int(slice_devices))
+    s = max(1, int(k) // per)
+    rs = nbytes * _ring_factor("reduce-scatter", per) / ici
+    ar = (nbytes / per) * _ring_factor("all-reduce", s) / dcn
+    ag = nbytes * _ring_factor("all-gather", per) / ici
+    return rs + ar + ag
+
+
 def comm_sizes_for_mesh(mesh_shape: Dict[str, int]) -> Dict[str, int]:
     """Sharding-plan mesh → per-collective participant counts.
 
@@ -134,18 +153,22 @@ def comm_sizes_for_mesh(mesh_shape: Dict[str, int]) -> Dict[str, int]:
     under tensor, and their product under 2d (the plan's
     compute_params/storage_grads constraint pair gathers and scatters
     over every axis the leaf is stored on).  all-reduce is the
-    gradient sum over all replicas — ``data × fsdp × model``, since
-    batch rows ride every mesh axis (sharding.py batch_spec: the
-    strategies change the storage layout, never the replica count)."""
+    gradient sum over all replicas — ``data × fsdp × model``, times
+    the ``slice`` axis when the mesh has one (plan_mesh emits it under
+    the hierarchical exchange; batch rows ride every mesh axis,
+    sharding.py batch_spec — the strategies change the storage layout,
+    never the replica count).  A mesh without a slice axis prices
+    exactly as before."""
     fsdp = int(mesh_shape.get("fsdp", 1))
     data = int(mesh_shape.get("data", 1))
     model = int(mesh_shape.get("model", 1))
+    slices = int(mesh_shape.get("slice", 1))
     return {
         "all-gather": fsdp * model,
         "reduce-scatter": fsdp * model,
-        "all-reduce": data * fsdp * model,
+        "all-reduce": data * fsdp * model * slices,
         "collective-permute": 2,
-        "all-to-all": max(data * fsdp * model, 1),
+        "all-to-all": max(data * fsdp * model * slices, 1),
     }
 
 
@@ -172,7 +195,8 @@ def section_of(component: str) -> str:
 def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
                      precision: str = "bfloat16",
                      comm_sizes: Optional[Dict[str, int]] = None,
-                     slice_devices: Optional[int] = None
+                     slice_devices: Optional[int] = None,
+                     exchange: str = "flat"
                      ) -> Dict[str, Any]:
     """Compiled-HLO text → predicted step time for ``target``.
 
@@ -182,9 +206,16 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
     collective is assumed 2-way — a single-device program has no
     collectives, so the default only matters when a caller lowered a
     sharded program and forgot the sizes.  A collective whose ring is
-    wider than ``slice_devices`` crosses a slice boundary and is
-    priced against the DCN NIC instead of ICI (None = single slice,
-    everything rides ICI — all current lowerings)."""
+    wider than ``slice_devices`` crosses a slice boundary: under the
+    default ``exchange="flat"`` its whole ring is priced against the
+    DCN NIC (the slowest link bounds a flat ring); under
+    ``exchange="hierarchical"`` a cross-slice all-reduce is priced as
+    its three phases instead — in-slice reduce-scatter on ICI, DCN
+    all-reduce of the 1/per-slice partials, in-slice all-gather back
+    (:func:`hierarchical_allreduce_seconds`).  ``slice_devices=None``
+    = single slice, everything rides ICI and ``exchange`` is inert —
+    single-slice predictions are bit-identical either way (the banked
+    calibration artifacts depend on that)."""
     spec = chip_spec(target)
     peak = float(spec["peak_flops"].get(precision)
                  or spec["peak_flops"]["bfloat16"])
@@ -209,11 +240,17 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
                        "collective_bytes": 0.0})
             if is_collective_opcode(ins.opcode):
                 k = _comm_k(comm_sizes, ins.opcode)
-                # the slowest link bounds the ring: DCN once it spans
-                # more devices than one slice holds
-                bw = (ici if (slice_devices is None
-                              or k <= slice_devices) else dcn)
-                t = ins.bytes * _ring_factor(ins.opcode, k) / bw
+                crosses = (slice_devices is not None
+                           and k > int(slice_devices))
+                if (crosses and exchange == "hierarchical"
+                        and ins.opcode.startswith("all-reduce")):
+                    t = hierarchical_allreduce_seconds(
+                        ins.bytes, k, int(slice_devices), ici, dcn)
+                else:
+                    # the slowest link bounds a flat ring: DCN once it
+                    # spans more devices than one slice holds
+                    bw = dcn if crosses else ici
+                    t = ins.bytes * _ring_factor(ins.opcode, k) / bw
                 totals["collective_bytes"] += ins.bytes
                 row["collective_bytes"] += ins.bytes
             else:
@@ -248,14 +285,17 @@ def predict_for_compiled(hlo_text: str,
                          device_kind: Optional[str] = None,
                          mesh_shape: Optional[Dict[str, int]] = None,
                          precision: str = "bfloat16",
-                         num_slices: int = 1) -> Dict[str, Any]:
+                         num_slices: int = 1,
+                         exchange: str = "flat") -> Dict[str, Any]:
     """ONE pricing entry point for an already-compiled program: derive
     the target from the device kind, the collective participant counts
     from the mesh, and the per-slice device count from ``num_slices``
-    (collectives spanning slices price against DCN).  The trainer's
-    gauge and bench's self-calibration point MUST price through this
-    one path — two hand-maintained invocation blocks would silently
-    diverge on exactly the pricing inputs calibration depends on."""
+    (collectives spanning slices price against DCN — as one flat ring
+    or as the three-phase hierarchical exchange, per ``exchange``).
+    The trainer's gauge and bench's self-calibration point MUST price
+    through this one path — two hand-maintained invocation blocks
+    would silently diverge on exactly the pricing inputs calibration
+    depends on."""
     target = (target_for_device_kind(device_kind) or DEFAULT_TARGET)
     mesh_shape = dict(mesh_shape or {})
     slice_devices = None
@@ -267,7 +307,7 @@ def predict_for_compiled(hlo_text: str,
     return predict_from_hlo(
         hlo_text, target=target, precision=precision,
         comm_sizes=comm_sizes_for_mesh(mesh_shape),
-        slice_devices=slice_devices)
+        slice_devices=slice_devices, exchange=exchange)
 
 
 # ---- AOT lowering of the real train step (CPU, no hardware) ---------
@@ -277,7 +317,9 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
                      pad_hw: Optional[Tuple[int, int]] = None,
                      strategy: str = "replicated",
                      fsdp_axis: int = 2,
-                     model_axis: int = 2
+                     model_axis: int = 2,
+                     num_slices: int = 1,
+                     exchange: str = "flat"
                      ) -> Tuple[str, Dict[str, Any]]:
     """AOT-lower + compile the real train step; → (hlo_text, meta).
 
@@ -288,9 +330,13 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
     ``(1, fsdp_axis, model_axis)`` mesh of host-platform devices
     (``fsdp`` sizes only the fsdp axis, ``tensor`` only the model
     axis, ``2d`` both — the model-axis collectives land in the HLO
-    and get priced).  Only compiles; never executes a step, so it
-    runs on any backend (the gate runs it under
-    ``JAX_PLATFORMS=cpu``).
+    and get priced).  ``num_slices > 1`` prepends a ``slice`` mesh
+    axis (``(num_slices, 1, fsdp, model)``) so the lowered program is
+    the multi-slice one — with ``exchange="hierarchical"`` the plan's
+    staged storage_grads constraints shape the gradient exchange into
+    the ICI-RS / DCN-AR / ICI-AG schedule the three-phase pricing
+    models.  Only compiles; never executes a step, so it runs on any
+    backend (the gate runs it under ``JAX_PLATFORMS=cpu``).
 
     ``meta`` carries the comm sizes for :func:`predict_from_hlo` plus
     the geometry, so a banked prediction is self-describing.
@@ -319,22 +365,34 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
             f"{strategy!r}")
     plan = None
     mesh_shape: Dict[str, int] = {}
+    ns = max(1, int(num_slices))
+    if ns > 1 and strategy == "replicated":
+        raise ValueError(
+            "multi-slice lowering needs a sharded strategy — "
+            "replicated has no mesh to carry the slice axis")
     if strategy != "replicated":
         from eksml_tpu.parallel import build_mesh
         from eksml_tpu.parallel.sharding import ShardingPlan
 
         f = fsdp_axis if strategy in ("fsdp", "2d") else 1
         m = model_axis if strategy in ("tensor", "2d") else 1
+        need = ns * f * m
         devices = jax.devices()
-        if len(devices) < f * m:
+        if len(devices) < need:
             raise ValueError(
-                f"{strategy} lowering needs {f * m} devices, have "
+                f"{strategy} lowering needs {need} devices, have "
                 f"{len(devices)} — set XLA_FLAGS=--xla_force_host_"
-                f"platform_device_count={f * m} before jax loads "
+                f"platform_device_count={need} before jax loads "
                 "(tools/perf_gate.py does)")
-        mesh = build_mesh((1, f, m), ("data", "fsdp", "model"),
-                          devices[:f * m], num_slices=1)
-        plan = ShardingPlan(strategy, mesh)
+        if ns > 1:
+            mesh = build_mesh(
+                (ns, 1, f, m), ("slice", "data", "fsdp", "model"),
+                devices[:need], num_slices=ns)
+            plan = ShardingPlan(strategy, mesh, exchange=exchange)
+        else:
+            mesh = build_mesh((1, f, m), ("data", "fsdp", "model"),
+                              devices[:need], num_slices=1)
+            plan = ShardingPlan(strategy, mesh)
         mesh_shape = dict(mesh.shape)
 
     # per-chip batch semantics under a plan (the trainer/bench
@@ -343,10 +401,10 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
     # the replica count); the replicated path is the historical
     # single-device program whose numbers the banked r5 artifacts
     # measured
-    global_bs = batch_size * (
-        mesh_shape.get("data", 1) * mesh_shape.get("fsdp", 1)
-        * mesh_shape.get("model", 1)
-        if plan is not None else 1)
+    n_mesh = 1
+    for v in mesh_shape.values():
+        n_mesh *= int(v)
+    global_bs = batch_size * (n_mesh if plan is not None else 1)
     batch = make_synthetic_batch(cfg, batch_size=global_bs,
                                  image_size=shape)
     batch = {k: jnp.asarray(v) for k, v in batch.items()
@@ -386,6 +444,10 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
         "remat": bool(getattr(cfg.TRAIN, "REMAT", False)),
         "comm_sizes": comm_sizes_for_mesh(mesh_shape),
         "mesh_shape": mesh_shape,
+        "num_slices": ns,
+        "slice_devices": (max(1, n_mesh // ns)
+                          if plan is not None else 1),
+        "exchange": (exchange if ns > 1 else "flat"),
     }
     return hlo, meta
 

@@ -1574,7 +1574,8 @@ def main(argv=None):
             records, cfg, per_host_batch, is_training=True,
             num_hosts=jax.process_count(), host_id=jax.process_index(),
             seed=cfg.TRAIN.SEED, with_masks=cfg.MODE_MASK,
-            ledger_dir=cfg.TRAIN.LOGDIR)
+            ledger_dir=cfg.TRAIN.LOGDIR,
+            num_slices=int(cfg.TPU.NUM_SLICES))
 
         total_steps = (args.total_steps
                        if args.total_steps is not None

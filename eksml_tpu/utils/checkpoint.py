@@ -93,6 +93,21 @@ class CheckpointManager:
             # its manifest before tracking the new in-flight one.
             self._write_pending_manifests(exclude=step)
             self._manifest_pending.add(step)
+            # The topology manifest describes the RUN's layout, not
+            # the commit, so it needs no deferral: publish it at
+            # dispatch, or an ungraceful death (SIGKILL, slice loss)
+            # strips the reshard evidence from every step whose
+            # integrity manifest was still pending — the restore
+            # would silently trust a stale layout.  If this commit
+            # never finalizes, prune_manifests sweeps the orphan.
+            if self.topology is not None and jax.process_index() == 0:
+                try:
+                    integrity.write_topology_manifest(
+                        self.directory, step, self.topology)
+                except OSError:
+                    log.exception(
+                        "topology manifest write failed for step %d",
+                        step)
             telemetry.default_registry().counter(
                 "eksml_checkpoint_saves",
                 "checkpoint commits started").inc()

@@ -319,3 +319,54 @@ def test_device_normalize_batches_are_uint8(fresh_config):
     nh, nw = int(u8["image_hw"][0, 0]), int(u8["image_hw"][0, 1])
     assert nh < 128  # 100x140 -> 91x128: rows pad
     assert u8["images"][0, nh:].max() == 0
+
+
+def test_loader_per_slice_sharding(fresh_config):
+    """Per-slice data sharding (ISSUE 18): hosts are slice-major, so
+    slice s owns the strided shard records[s::num_slices] and its
+    hosts restride within it — all host shards stay pairwise
+    disjoint, their union covers every record, and each host reads
+    only from its own slice's shard.  num_slices=1 (and host counts
+    the slice count does not divide) keep the historical layout
+    byte-for-byte."""
+    fresh_config.PREPROC.MAX_SIZE = 64
+    fresh_config.PREPROC.TRAIN_SHORT_EDGE_SIZE = (64, 64)
+    ds = SyntheticDataset(num_images=12, height=64, width=64)
+    records = ds.records()
+    all_ids = [r["image_id"] for r in records]
+
+    shards = {}
+    for host in range(4):  # 2 slices x 2 hosts
+        loader = DetectionLoader(records, fresh_config, batch_size=2,
+                                 num_hosts=4, host_id=host,
+                                 num_slices=2, with_masks=False,
+                                 seed=3)
+        shards[host] = [r["image_id"] for r in loader.records]
+    # hosts 0,1 are slice 0 (even records), hosts 2,3 slice 1 (odd)
+    slice0 = set(shards[0]) | set(shards[1])
+    slice1 = set(shards[2]) | set(shards[3])
+    assert slice0 == set(all_ids[0::2])
+    assert slice1 == set(all_ids[1::2])
+    # pairwise disjoint, union = everything (no record read twice,
+    # none dropped)
+    seen = [i for h in range(4) for i in shards[h]]
+    assert len(seen) == len(set(seen)) == len(all_ids)
+
+    # num_slices=1: bit-identical to the historical host shard
+    for host in range(2):
+        a = DetectionLoader(records, fresh_config, batch_size=2,
+                            num_hosts=2, host_id=host,
+                            with_masks=False, seed=3)
+        b = DetectionLoader(records, fresh_config, batch_size=2,
+                            num_hosts=2, host_id=host, num_slices=1,
+                            with_masks=False, seed=3)
+        assert ([r["image_id"] for r in a.records]
+                == [r["image_id"] for r in b.records])
+    # a slice count that does not divide the hosts falls back to the
+    # flat host stride (never a partial slice-major layout)
+    c = DetectionLoader(records, fresh_config, batch_size=2,
+                        num_hosts=3, host_id=1, num_slices=2,
+                        with_masks=False, seed=3)
+    assert ([r["image_id"] for r in c.records]
+            == [r["image_id"] for r in records[1::3]])
+

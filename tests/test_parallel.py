@@ -333,3 +333,34 @@ def test_warm_mesh_collectives_runs_mesh_allreduce(monkeypatch):
     # devices — same program, local transport)
     monkeypatch.setattr(collectives.jax, "process_count", lambda: 2)
     collectives.warm_mesh_collectives(mesh)  # raises on failure
+
+
+def test_topology_manifest_round_trip_carries_slice_count():
+    """The checkpoint topology manifest must carry num_slices through
+    a JSON round-trip: a checkpoint saved at 2 slices restored at 1
+    slice is a resharded restore, not a trusted-layout one — losing
+    the field would alias the two."""
+    import json
+
+    from eksml_tpu.parallel.mesh import build_mesh
+    from eksml_tpu.parallel.sharding import ShardingPlan
+    from eksml_tpu.parallel.topology import (compatible,
+                                             current_topology, diff,
+                                             normalize)
+
+    mesh = build_mesh((2, 1, 2, 2), ("slice", "data", "fsdp", "model"),
+                      num_slices=2)
+    plan = ShardingPlan("2d", mesh, exchange="hierarchical")
+    topo = current_topology(mesh, plan, num_slices=2)
+    assert topo["num_slices"] == 2
+    assert topo["mesh_axes"] == ["slice", "data", "fsdp", "model"]
+    # JSON round-trip (what the checkpoint manifest actually does)
+    loaded = normalize(json.loads(json.dumps(topo)))
+    assert compatible(topo, loaded) and compatible(loaded, topo)
+    # a single-slice layout of the same shard widths is NOT the same
+    # topology — num_slices (and the mesh axes) must break equality
+    flat = build_mesh((2, 2, 2), ("data", "fsdp", "model"))
+    topo1 = current_topology(flat, ShardingPlan("2d", flat),
+                             num_slices=1)
+    assert not compatible(loaded, topo1)
+    assert "num_slices" in diff(loaded, topo1)

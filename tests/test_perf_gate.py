@@ -877,3 +877,152 @@ def test_serve_rung_vs_committed_baseline_and_probe(serve_lowering):
     assert "regressed" in row2["error"]
     # the message names the worst component, never a bare number
     assert "predicted +" in row2["error"]
+
+
+# ---- multi-slice hierarchical exchange pricing (ISSUE 18) -----------
+
+
+def test_comm_sizes_for_mesh_slice_axis():
+    """A slice axis multiplies the gradient all-reduce (batch rows
+    ride every mesh axis, slice included) but NOT the layout moves —
+    all-gather/reduce-scatter stay in-slice storage traffic.  Meshes
+    without the axis price exactly as before (the committed bank)."""
+    ms = P.comm_sizes_for_mesh({"slice": 2, "data": 1, "fsdp": 2,
+                                "model": 2})
+    assert ms["all-gather"] == 4
+    assert ms["reduce-scatter"] == 4
+    assert ms["all-reduce"] == 8
+    assert ms["all-to-all"] == 8
+    # no slice key: bit-identical to the historical values
+    assert (P.comm_sizes_for_mesh({"data": 1, "fsdp": 2, "model": 2})
+            ["all-reduce"] == 4)
+
+
+def test_hierarchical_three_phase_price():
+    """The satellite fix: a cross-slice all-reduce under the
+    hierarchical exchange prices as ICI reduce-scatter + DCN
+    all-reduce of the 1/per partials + ICI all-gather — strictly
+    below the flat ring at DCN speed, and degenerating to it at
+    per-slice device count 1."""
+    spec = P.chip_spec("v5e")
+    ici = float(spec["ici_bytes_per_sec"])
+    dcn = float(spec["dcn_bytes_per_sec"])
+    nbytes, k, per = 1e9, 8, 4
+    s = k // per
+    hier = P.hierarchical_allreduce_seconds(nbytes, k, per, ici, dcn)
+    expect = (nbytes * (per - 1) / per / ici
+              + (nbytes / per) * 2.0 * (s - 1) / s / dcn
+              + nbytes * (per - 1) / per / ici)
+    assert hier == pytest.approx(expect, rel=1e-12)
+    flat = nbytes * 2.0 * (k - 1) / k / dcn
+    assert hier < flat
+    # per=1: no in-slice phase exists — the "hierarchy" IS the flat
+    # ring over the slices
+    assert (P.hierarchical_allreduce_seconds(nbytes, 4, 1, ici, dcn)
+            == pytest.approx(nbytes * 2.0 * 3 / 4 / dcn, rel=1e-12))
+
+
+def test_predict_from_hlo_exchange_modes():
+    """exchange= reshapes ONLY the cross-slice all-reduce price:
+    hierarchical beats flat on the same HLO, and at a single slice
+    (slice_devices=None) both spellings are bit-identical — the
+    committed single-slice bank must never move."""
+    flat = P.predict_from_hlo(HLO_FIXTURE, precision="float32",
+                              comm_sizes={"all-reduce": 4},
+                              slice_devices=2, exchange="flat")
+    hier = P.predict_from_hlo(HLO_FIXTURE, precision="float32",
+                              comm_sizes={"all-reduce": 4},
+                              slice_devices=2, exchange="hierarchical")
+    assert (hier["sections_ms"]["comms"]
+            < flat["sections_ms"]["comms"])
+    one_flat = P.predict_from_hlo(HLO_FIXTURE, precision="float32",
+                                  comm_sizes={"all-reduce": 4})
+    one_hier = P.predict_from_hlo(HLO_FIXTURE, precision="float32",
+                                  comm_sizes={"all-reduce": 4},
+                                  exchange="hierarchical")
+    assert one_flat == one_hier
+
+
+def test_predict_for_compiled_threads_exchange():
+    mesh = {"slice": 2, "data": 2, "fsdp": 1, "model": 1}
+    flat = P.predict_for_compiled(HLO_FIXTURE, device_kind="TPU v5e",
+                                  mesh_shape=mesh, precision="float32",
+                                  num_slices=2)
+    hier = P.predict_for_compiled(HLO_FIXTURE, device_kind="TPU v5e",
+                                  mesh_shape=mesh, precision="float32",
+                                  num_slices=2,
+                                  exchange="hierarchical")
+    assert (hier["sections_ms"]["comms"]
+            < flat["sections_ms"]["comms"])
+
+
+def test_axis_widths_slices_column():
+    """The slices column generalizes the verdict rows — but ONLY for
+    meshes that carry a slice axis; single-slice rows keep the
+    two-key shape every banked artifact and its consumers pin."""
+    assert perf_gate.axis_widths({"data": 1, "fsdp": 4, "model": 2}) \
+        == {"fsdp": 4, "model": 2}
+    assert perf_gate.axis_widths(
+        {"slice": 2, "data": 1, "fsdp": 2, "model": 2}) \
+        == {"fsdp": 2, "model": 2, "slices": 2}
+    assert perf_gate.axis_widths({"slice": 1, "data": 8}) \
+        == {"fsdp": 1, "model": 1}
+
+
+def test_multislice_rung_specs_restrict_strategies():
+    for rung, slices in (("128_b1_s2", 2), ("128_b1_s4", 4)):
+        spec = perf_gate.PRED_RUNGS[rung]
+        assert spec["num_slices"] == slices
+        assert spec["strategies"] == ("2d",)
+    # the CI default includes both multislice rungs
+    for rung in ("128_b1_s2", "128_b1_s4"):
+        assert rung in perf_gate.DEFAULT_RUNGS.split(",")
+
+
+def test_gate_fails_unless_hierarchical_beats_flat(tmp_path):
+    """A multi-slice row carries the flat counterfactual price, and
+    the gate FAILs when hierarchical is not strictly faster — the win
+    this rung exists to prove."""
+    fresh = {"key": "128_b1_s2_2d_bfloat16",
+             "predicted_step_time_ms": 5.0,
+             "sections_ms": {"fwd": 4.0, "comms": 1.0},
+             "components_ms": {"backbone": 5.0},
+             "mesh_shape": {"slice": 2, "data": 1, "fsdp": 2,
+                            "model": 2},
+             "num_slices": 2,
+             "flat_predicted_step_time_ms": 8.0}
+    with open(tmp_path / "perf_pred_128_b1_s2_2d_bfloat16.json",
+              "w") as f:
+        json.dump(fresh, f)
+    row = perf_gate.gate_one(fresh, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "PASS"
+    assert row["axis_widths"] == {"fsdp": 2, "model": 2, "slices": 2}
+    assert row["flat_predicted_step_time_ms"] == 8.0
+    slower = dict(fresh)
+    slower["flat_predicted_step_time_ms"] = 5.0  # equal: not a win
+    row = perf_gate.gate_one(slower, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "FAIL"
+    assert "not strictly faster" in row["error"]
+
+
+@pytest.mark.slow
+def test_multislice_prediction_vs_committed_baseline(fresh_config):
+    """The hermetic acceptance drive at 2 slices: predict_rung lowers
+    the hierarchical 2d program over a (2, 1, 2, 2) slice mesh,
+    prices it both ways, beats the flat counterfactual, and PASSes
+    against the COMMITTED bank."""
+    rec = perf_gate.predict_rung("128_b1_s2", "2d", "bfloat16", "v5e")
+    assert rec["mesh_shape"] == {"slice": 2, "data": 1, "fsdp": 2,
+                                 "model": 2}
+    assert rec["num_slices"] == 2 and rec["slice_devices"] == 4
+    assert rec["exchange"] == "hierarchical"
+    assert (rec["predicted_step_time_ms"]
+            < rec["flat_predicted_step_time_ms"])
+    row = perf_gate.gate_one(rec, os.path.join(REPO, "artifacts"),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "PASS", row

@@ -877,3 +877,125 @@ def test_dryrun_multichip_tensor_entry(capsys):
     out = capsys.readouterr().out
     assert "total_loss=8.8102" in out
     assert "tensor(model=4" in out
+
+
+# ---- multi-slice hierarchical exchange (ISSUE 18) -------------------
+
+
+def _cfg_multislice(strategy="2d", fsdp=2, model=2, num_slices=2,
+                    exchange="hierarchical"):
+    cfg = _cfg_with(strategy, fsdp=fsdp, model=model)
+    cfg.freeze(False)
+    cfg.TPU.NUM_SLICES = num_slices
+    cfg.TRAIN.SHARDING.EXCHANGE = exchange
+    cfg.freeze()
+    return cfg
+
+
+MESH4 = ("slice", "data", "fsdp", "model")
+
+
+def test_plan_mesh_hierarchical_emits_slice_axis():
+    """EXCHANGE=hierarchical at NUM_SLICES>1 makes the DCN
+    decomposition explicit: a leading slice axis of exactly the slice
+    count, the in-slice axes sized per slice."""
+    shape, axes = plan_mesh(_cfg_multislice(), 8)
+    assert axes == MESH4
+    assert dict(zip(axes, shape)) == {"slice": 2, "data": 1,
+                                      "fsdp": 2, "model": 2}
+    # fsdp-only composition: FSDP_AXIS_SIZE=0 still resolves to one
+    # slice's devices, never the DCN-spanning total
+    shape, axes = plan_mesh(_cfg_multislice("fsdp", fsdp=0, model=0),
+                            8)
+    assert dict(zip(axes, shape)) == {"slice": 2, "data": 1,
+                                      "fsdp": 4, "model": 1}
+
+
+def test_plan_mesh_hierarchical_straddle_refusal():
+    # the no-DCN-hop shard-group guard holds under the hierarchical
+    # exchange too: 4 devices/slice cannot host a 4x2 group
+    with pytest.raises(ValueError, match="DCN"):
+        plan_mesh(_cfg_multislice(fsdp=4, model=2), 8)
+
+
+def test_plan_mesh_flat_exchange_keeps_legacy_mesh():
+    """EXCHANGE=flat at NUM_SLICES>1 keeps the 3-axis mesh — the
+    slice decomposition stays implicit in build_mesh's slice-major
+    device order, and every banked single-exchange artifact keeps its
+    meaning."""
+    shape, axes = plan_mesh(_cfg_multislice(exchange="flat"), 8)
+    assert (shape, axes) == ((2, 2, 2), MESH3)
+
+
+def test_plan_mesh_rejects_unknown_exchange():
+    with pytest.raises(ValueError, match="EXCHANGE"):
+        plan_mesh(_cfg_multislice(exchange="tree"), 8)
+
+
+def test_build_mesh_slice_axis_size_must_match():
+    m = build_mesh((2, 1, 2, 2), MESH4, num_slices=2)
+    assert m.devices.shape == (2, 1, 2, 2)
+    # the slice axis IS the DCN decomposition — it can neither split
+    # nor merge hardware slices
+    with pytest.raises(ValueError, match="slice axis size"):
+        build_mesh((4, 1, 2, 1), MESH4, num_slices=2)
+
+
+def test_sharding_plan_exchange_validation_and_describe():
+    mesh = build_mesh((2, 1, 2, 2), MESH4, num_slices=2)
+    with pytest.raises(ValueError, match="EXCHANGE"):
+        ShardingPlan("2d", mesh, exchange="tree")
+    plan = ShardingPlan("2d", mesh, exchange="hierarchical")
+    assert plan.slice_axis_size == 2
+    assert "slices=2" in plan.describe()
+    assert "exchange=hierarchical" in plan.describe()
+    # single-slice describe strings unchanged (banked JSON lines and
+    # the dryrun stdout pins read them verbatim)
+    p1 = ShardingPlan("2d", build_mesh((1, 2, 2), MESH3))
+    assert "slices" not in p1.describe()
+    assert "exchange" not in p1.describe()
+
+
+def test_exchange_specs_stage_on_in_slice_axes():
+    """The intermediate layout shards each gradient leaf over every
+    in-slice axis jointly and stays REPLICATED over slice — exactly
+    the layout whose constraint pair forces in-slice reduce-scatter,
+    DCN all-reduce of the 1/per-slice partials, in-slice all-gather
+    back."""
+    mesh = build_mesh((2, 1, 2, 2), MESH4, num_slices=2)
+    plan = ShardingPlan("2d", mesh, exchange="hierarchical")
+    grads = {"k": np.zeros((16, 8), np.float32),
+             "b": np.zeros((3,), np.float32),
+             "step": np.zeros((), np.int32)}
+    inter = plan.exchange_specs(grads)
+    storage = plan.specs(grads)
+    assert inter["k"] == P(("fsdp", "model"))
+    assert inter["b"] == storage["b"]   # indivisible: storage layout
+    assert inter["step"] == P()         # scalars never partition
+
+
+def test_hierarchical_storage_grads_values_unchanged():
+    """storage_grads is a re-layout, never math: the staged exchange
+    must return bit-identical values (the 8.8102 dryrun pin depends
+    on it)."""
+    mesh = build_mesh((2, 1, 2, 2), MESH4, num_slices=2)
+    plan = ShardingPlan("2d", mesh, exchange="hierarchical")
+    g = {"k": np.arange(128, dtype=np.float32).reshape(16, 8)}
+    out = jax.jit(plan.storage_grads)(g)
+    np.testing.assert_array_equal(np.asarray(out["k"]), g["k"])
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_hierarchical_2slice_entry(capsys):
+    """The ISSUE 18 acceptance entry: dryrun_multichip at 2 fake
+    slices with the hierarchical exchange — loss bit-identical to the
+    pinned 8.8102 single-slice value (the exchange reshapes the
+    collective schedule, never the math)."""
+    import __graft_entry__ as entry
+
+    entry.dryrun_multichip(8, strategy="2d", fsdp_axis_size=2,
+                           model_axis_size=2, num_slices=2,
+                           exchange="hierarchical")
+    out = capsys.readouterr().out
+    assert "total_loss=8.8102" in out
+    assert "slices=2, exchange=hierarchical" in out

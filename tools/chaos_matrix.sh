@@ -91,6 +91,17 @@
 # checkpoint path (SIGTERM → exit 77 → relaunch, elastic resume
 # resharding), the loss stream continuous throughout, and the merged
 # goodput ledger attributing the bounded between-relaunch downtime.
+# unit-multislice covers the hierarchical multi-slice gradient
+# exchange (ISSUE 18): the explicit 'slice' mesh axis and straddle
+# refusal in plan_mesh, the staged ICI-RS → DCN-AR → ICI-AG exchange
+# specs with bit-identical storage_grads values, the three-phase ring
+# price (hierarchical strictly under the flat DCN ring at every
+# priced size), the slices column in the perf-gate rows, and the
+# topology manifest carrying the slice count through a JSON
+# round-trip.  proc-slice-loss is the runtime proof: SIGKILL a
+# 2-slice 8-chip run mid-epoch, elastically resume single-slice at 4
+# chips (flat exchange — one slice has no DCN hop), then grow back
+# to 2 slices — every crossing resharded, the loss stream continuous.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # (or `-m eksml_tpu.serve`) processes and are marked slow (excluded
 # from tier-1); the unit and data-* rungs run in seconds.  Everything runs under
@@ -119,6 +130,7 @@ RUNGS=(
   "unit-goodput|tests/test_goodput.py tests/test_trace_summary.py"
   "unit-sharding|tests/test_sharding.py -k 'not (tensor or 2d)'"
   "unit-sharding-2d|tests/test_sharding.py -k 'tensor or 2d'"
+  "unit-multislice|tests/test_sharding.py tests/test_parallel.py tests/test_perf_gate.py -k 'slice or hierarchical or multislice'"
   "unit-perfgate|tests/test_perf_gate.py"
   "unit-serve|tests/test_serve.py"
   "unit-serve-reload|tests/test_serve_reload.py"
@@ -133,6 +145,7 @@ RUNGS=(
   "proc-sigkill-resume|tests/test_fault_tolerance.py::test_sigkill_then_resume"
   "proc-sigterm-graceful|tests/test_fault_tolerance.py::test_sigterm_graceful_preempt_then_resume"
   "proc-elastic-resume|tests/test_fault_tolerance.py::test_elastic_resume_grow_shrink"
+  "proc-slice-loss|tests/test_fault_tolerance.py::test_slice_loss_shrink_grow"
   "proc-capacity-wave|tests/test_fault_tolerance.py::test_operator_capacity_wave"
   "proc-corrupt-latest|tests/test_fault_tolerance.py::test_corrupt_latest_checkpoint_falls_back"
   "proc-nan-rollback|tests/test_fault_tolerance.py::test_nan_loss_rolls_back_and_never_checkpoints_poison"

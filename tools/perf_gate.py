@@ -73,12 +73,25 @@ PRED_RUNGS: Dict[str, Dict[str, Any]] = {
     "1344_b4": {"image_size": 1344, "batch_size": 4},
     "1344_b8_remat": {"image_size": 1344, "batch_size": 8,
                       "remat": True, "param_dtype": "bfloat16"},
+    # multi-slice rungs: a slice is internally fsdp x model (the 2D
+    # layout), slices exchange only gradients over DCN.  Lowered with
+    # the hierarchical exchange and priced BOTH ways from the same
+    # HLO — the rung FAILs unless hierarchical is strictly faster
+    # than the flat DCN ring (the win this gate exists to gate).
+    # "strategies" restricts the plan: a slice axis only means
+    # anything composed with a sharded in-slice layout.
+    "128_b1_s2": {"image_size": 128, "batch_size": 1,
+                  "num_slices": 2, "strategies": ("2d",)},
+    "128_b1_s4": {"image_size": 128, "batch_size": 1,
+                  "num_slices": 4, "strategies": ("2d",)},
 }
 
-#: the CI default: two cheap geometries × every executable strategy —
-#: ~8 tiny-model compiles, bounded minutes on one CPU core (the
-#: tensor/2d rungs price the model-axis collectives hermetically)
-DEFAULT_RUNGS = "128_b1,256_b1"
+#: the CI default: two cheap geometries × every executable strategy
+#: plus the two multi-slice rungs (2d-only) — ~10 tiny-model
+#: compiles, bounded minutes on one CPU core (the tensor/2d rungs
+#: price the model-axis collectives hermetically; the _s2/_s4 rungs
+#: price the cross-slice DCN exchange hierarchical-vs-flat)
+DEFAULT_RUNGS = "128_b1,256_b1,128_b1_s2,128_b1_s4"
 DEFAULT_STRATEGIES = "replicated,fsdp,tensor,2d"
 
 # Serving (bucket, batch) rungs priced by --serve: the PREDICT step
@@ -141,9 +154,15 @@ def axis_widths(mesh_shape: Dict[str, Any]) -> Dict[str, int]:
     """Resolved (fsdp, model) widths of a lowered rung's mesh — the
     verdict-row field that keeps a 2d rung from being confused with
     its 1D siblings in the bank (same rung name, same strategy
-    string, different shard widths)."""
-    return {"fsdp": int((mesh_shape or {}).get("fsdp", 1)),
-            "model": int((mesh_shape or {}).get("model", 1))}
+    string, different shard widths).  A mesh with a ``slice`` axis
+    adds a ``slices`` column; single-slice rows keep the historical
+    two-key shape (banked artifacts and their consumers pin it)."""
+    widths = {"fsdp": int((mesh_shape or {}).get("fsdp", 1)),
+              "model": int((mesh_shape or {}).get("model", 1))}
+    slices = int((mesh_shape or {}).get("slice", 1))
+    if slices > 1:
+        widths["slices"] = slices
+    return widths
 
 
 def row_axis_widths(rec: Dict[str, Any]) -> Optional[Dict[str, int]]:
@@ -169,14 +188,21 @@ def predict_rung(rung: str, strategy: str, precision: str,
     # precision would overwrite the wrong baseline (the bench.py
     # re-derivation rule)
     precision = str(cfg.TRAIN.PRECISION)
+    num_slices = int(spec.get("num_slices", 1))
+    exchange = "hierarchical" if num_slices > 1 else "flat"
     t0 = time.time()
     hlo, meta = P.lower_train_step(
         cfg, batch_size=spec["batch_size"],
         image_size=spec.get("image_size"),
         pad_hw=spec.get("pad_hw"), strategy=strategy,
-        fsdp_axis=fsdp_axis, model_axis=model_axis)
+        fsdp_axis=fsdp_axis, model_axis=model_axis,
+        num_slices=num_slices, exchange=exchange)
+    slice_devices = (meta["slice_devices"] if num_slices > 1
+                     else None)
     pred = P.predict_from_hlo(hlo, target=target, precision=precision,
-                              comm_sizes=meta["comm_sizes"])
+                              comm_sizes=meta["comm_sizes"],
+                              slice_devices=slice_devices,
+                              exchange=exchange)
     rec = dict(pred)
     rec.update({
         "rung": rung,
@@ -192,6 +218,21 @@ def predict_rung(rung: str, strategy: str, precision: str,
         "lower_seconds": round(time.time() - t0, 1),
         "banked_at": _utcnow(),
     })
+    if num_slices > 1:
+        # price the SAME compiled program as one flat ring at the
+        # slowest link — the counterfactual the hierarchical exchange
+        # is gated against (it must be strictly faster, gate_one)
+        flat = P.predict_from_hlo(
+            hlo, target=target, precision=precision,
+            comm_sizes=meta["comm_sizes"],
+            slice_devices=slice_devices, exchange="flat")
+        rec.update({
+            "num_slices": num_slices,
+            "slice_devices": meta["slice_devices"],
+            "exchange": exchange,
+            "flat_predicted_step_time_ms":
+                flat["predicted_step_time_ms"],
+        })
     return rec
 
 
@@ -269,6 +310,24 @@ def gate_one(fresh: Dict, bank_dir: str, max_regress_pct: float,
         # its 1D siblings share rung names, and the bank must never
         # let one masquerade as the other
         row["axis_widths"] = widths
+    flat_ms = fresh.get("flat_predicted_step_time_ms")
+    if flat_ms is not None:
+        # the multi-slice rung's reason to exist: under the banked
+        # DCN calibration the hierarchical exchange must be strictly
+        # faster than pricing the same program as one flat ring at
+        # the slowest link — equal-or-slower means the three-phase
+        # schedule is not paying for itself
+        row["flat_predicted_step_time_ms"] = flat_ms
+        if fresh["predicted_step_time_ms"] >= flat_ms:
+            row["gate"] = "FAIL"
+            row["error"] = (
+                f"hierarchical exchange predicted "
+                f"{fresh['predicted_step_time_ms']}ms is not "
+                f"strictly faster than the flat DCN ring "
+                f"({flat_ms}ms) at num_slices="
+                f"{fresh.get('num_slices')} — the exchange pricing "
+                f"or the staged collectives regressed")
+            return row
     if base is not None:
         base_widths = row_axis_widths(base)
         if (widths is not None and base_widths is not None
@@ -367,9 +426,15 @@ def main(argv=None) -> int:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             # the 2d lowering shards over fsdp x model jointly — the
-            # host platform must carry the axis PRODUCT
+            # host platform must carry the axis PRODUCT, times the
+            # widest slice count any requested rung lowers at
+            max_slices = max(
+                [1] + [int(PRED_RUNGS[r.strip()].get("num_slices", 1))
+                       for r in args.rungs.split(",")
+                       if r.strip() in PRED_RUNGS])
             n_virtual = max(2, args.fsdp_axis, args.model_axis,
-                            args.fsdp_axis * args.model_axis)
+                            args.fsdp_axis * args.model_axis
+                            * max_slices)
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count="
                         f"{n_virtual}").strip()
@@ -413,8 +478,13 @@ def main(argv=None) -> int:
             if bad:
                 p.error(f"unknown rung(s) {bad}; known: "
                         f"{sorted(PRED_RUNGS)}")
+            # a rung may restrict its strategy axis (the multi-slice
+            # rungs only mean anything over a sharded in-slice
+            # layout) — absent the key, every requested strategy runs
             plan = [(rung, strategy) for rung in rungs
-                    for strategy in strategies]
+                    for strategy in strategies
+                    if strategy in PRED_RUNGS[rung].get("strategies",
+                                                        strategies)]
         for rung, strategy in plan:
             print(f"perf_gate: lowering {rung}"
                   + (f" x {strategy}" if strategy else " (serve)")
@@ -459,6 +529,9 @@ def main(argv=None) -> int:
                 widths = row_axis_widths(fresh)
                 if widths is not None:
                     banked_row["axis_widths"] = widths
+                if "flat_predicted_step_time_ms" in fresh:
+                    banked_row["flat_predicted_step_time_ms"] = (
+                        fresh["flat_predicted_step_time_ms"])
                 verdict["results"].append(banked_row)
             else:
                 row = gate_one(fresh, args.bank_dir,
