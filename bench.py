@@ -933,6 +933,10 @@ def run(args, diag: dict) -> None:
             diag["predicted_step_time_ms"] = \
                 pred["predicted_step_time_ms"]
             diag["predicted_sections_ms"] = pred["sections_ms"]
+            # the per-link split (ISSUE 19): ici/dcn/exposed ms from
+            # the replica_groups-exact pricing, so a hardware round
+            # banks the link-level prediction next to the measurement
+            diag["predicted_comms_ms"] = pred.get("comms_ms")
             diag["predicted_target"] = pred["target"]
         except Exception as e:  # noqa: BLE001 — prediction is advisory
             print(f"bench: step-time prediction unavailable: {e}",

@@ -79,6 +79,85 @@ def is_collective_opcode(opcode: str) -> bool:
     these against link bandwidth (ICI), not HBM (predict.py)."""
     return opcode in _COLLECTIVE_OPS
 
+
+# ---- replica_groups parsing (the communication observatory's input) --
+#
+# Every collective instruction names its exact participant sets.  Two
+# spellings exist in compiled HLO:
+#   explicit  replica_groups={{0,1},{2,3}}
+#   iota      replica_groups=[4,2]<=[2,2,2]T(0,2,1)
+# The iota (v2) form means: enumerate 0..prod(dims)-1, reshape to
+# `dims`, transpose by `perm` (T(...) — identity when absent), then
+# reshape to G groups of N.  collective-permute spells its topology as
+# source_target_pairs={{s,t},...} instead — each pair is a 2-group.
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=\{((?:\{[0-9, ]*\}(?:, ?)?)*)\}")
+_PAIRS_RE = re.compile(
+    r"source_target_pairs=\{((?:\{\d+, ?\d+\}(?:, ?)?)+)\}")
+_GROUP_RE = re.compile(r"\{([0-9, ]*)\}")
+
+
+def _iota_groups(g: int, n: int, dims: List[int],
+                 perm: Optional[List[int]]
+                 ) -> Tuple[Tuple[int, ...], ...]:
+    """Decode the iota form: iota(prod(dims)) → reshape(dims) →
+    transpose(perm) → reshape(g, n).  Pure index arithmetic — no
+    array dependency."""
+    total = 1
+    for d in dims:
+        total *= d
+    if perm is None:
+        flat = list(range(total))
+    else:
+        tdims = [dims[p] for p in perm]
+        strides = [1] * len(dims)
+        for i in range(len(dims) - 2, -1, -1):
+            strides[i] = strides[i + 1] * dims[i + 1]
+        flat = []
+        idx = [0] * len(tdims)
+        for _ in range(total):
+            flat.append(sum(idx[a] * strides[perm[a]]
+                            for a in range(len(perm))))
+            for a in range(len(tdims) - 1, -1, -1):
+                idx[a] += 1
+                if idx[a] < tdims[a]:
+                    break
+                idx[a] = 0
+    return tuple(tuple(flat[i * n:(i + 1) * n]) for i in range(g))
+
+
+def parse_collective_groups(
+        line: str) -> Optional[Tuple[Tuple[int, ...], ...]]:
+    """One HLO collective line → its exact device-id groups, or None
+    when the line carries no group info (``replica_groups={}``, or a
+    hand-rolled fixture without the attribute) — callers synthesize a
+    plan-sized contiguous group in that case (predict.py)."""
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        g, n = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        perm = ([int(d) for d in m.group(4).split(",")]
+                if m.group(4) else None)
+        return _iota_groups(g, n, dims, perm)
+    m = _REPLICA_GROUPS_RE.search(line)
+    if m:
+        groups = tuple(
+            tuple(int(x) for x in grp.replace(" ", "").split(",")
+                  if x)
+            for grp in _GROUP_RE.findall(m.group(1)))
+        groups = tuple(g for g in groups if g)
+        return groups or None
+    m = _PAIRS_RE.search(line)
+    if m:
+        pairs = tuple(
+            tuple(int(x) for x in grp.replace(" ", "").split(","))
+            for grp in _GROUP_RE.findall(m.group(1)))
+        return pairs or None
+    return None
+
 # op_name scope → component.  First match wins; searched on the
 # lowercased path.  ``bwd_split=True`` components get a "-bwd" suffix
 # when the path shows a transpose context (the backward pass).  Scope
@@ -126,10 +205,10 @@ _OPERAND_RE = re.compile(r"%([\w.\-]+)")
 
 class Instr:
     __slots__ = ("name", "opcode", "op_name", "calls", "operands",
-                 "cost", "flops", "bytes")
+                 "cost", "flops", "bytes", "groups")
 
     def __init__(self, name, opcode, op_name, calls, operands, cost,
-                 flops, nbytes):
+                 flops, nbytes, groups=None):
         self.name = name
         self.opcode = opcode
         self.op_name = op_name          # metadata path ("" if absent)
@@ -138,6 +217,7 @@ class Instr:
         self.cost = cost                # modeled roofline cost (bytes-eq)
         self.flops = flops
         self.bytes = nbytes
+        self.groups = groups            # exact replica_groups (or None)
 
 
 def _shape_elems_bytes(tokens: List[Tuple[str, str]]) -> int:
@@ -233,8 +313,10 @@ def parse_hlo(text: str) -> Tuple[Dict[str, List[Instr]], Optional[str]]:
             nbytes = float(_shape_elems_bytes(shapes))
             flops = _modeled_flops(opcode, line, shapes)
             cost = nbytes + flops / FLOPS_PER_BYTE
+        groups = (parse_collective_groups(line)
+                  if opcode in _COLLECTIVE_OPS else None)
         cur.append(Instr(name, opcode, op_name, calls, operands, cost,
-                         flops, nbytes))
+                         flops, nbytes, groups))
     return comps, entry
 
 

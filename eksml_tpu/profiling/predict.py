@@ -10,10 +10,13 @@ the existing attribution parser (attribution.py), and price every
 instruction against the target chip's roofline:
 
 - compute ops:    ``t = max(flops / peak_flops, bytes / hbm_bw)``
-- collectives:    ``t = bytes × ring_factor(k) / ici_bw`` with ``k``
-  the participating-device count from the sharding plan (PR 6) — an
-  all-reduce moves ``2(k-1)/k`` of its payload per link, a
-  reduce-scatter/all-gather ``(k-1)/k``.
+- collectives:    ``t = bytes × ring_factor(k) / link_bw`` with ``k``
+  and the link (ICI / DCN / the mixed staged composition) read from
+  the instruction's exact ``replica_groups`` (ISSUE 19,
+  :func:`price_collective`) — an all-reduce moves ``2(k-1)/k`` of its
+  payload per link, a reduce-scatter/all-gather ``(k-1)/k``.  A
+  groupless line falls back to a contiguous group of the sharding
+  plan's size (PR 6 ``comm_sizes``) through the same path.
 
 Summing per resolved component (SCOPE_RULES) yields a predicted step
 time that is *component-attributed*: a regression names the component
@@ -126,6 +129,23 @@ def _ring_factor(opcode: str, k: int) -> float:
     return float(k - 1) / k
 
 
+def hierarchical_allreduce_split(nbytes: float, k: int,
+                                 slice_devices: int,
+                                 ici: float, dcn: float
+                                 ) -> Tuple[float, float]:
+    """The three-phase hierarchical all-reduce price split by link:
+    → (ici_seconds, dcn_seconds).  ICI carries the in-slice
+    reduce-scatter + all-gather over the ``per`` in-slice devices;
+    DCN carries the all-reduce of the 1/per-sized partials over the
+    ``s = k // per`` slices."""
+    per = max(1, int(slice_devices))
+    s = max(1, int(k) // per)
+    rs = nbytes * _ring_factor("reduce-scatter", per) / ici
+    ar = (nbytes / per) * _ring_factor("all-reduce", s) / dcn
+    ag = nbytes * _ring_factor("all-gather", per) / ici
+    return rs + ag, ar
+
+
 def hierarchical_allreduce_seconds(nbytes: float, k: int,
                                    slice_devices: int,
                                    ici: float, dcn: float) -> float:
@@ -137,12 +157,95 @@ def hierarchical_allreduce_seconds(nbytes: float, k: int,
     the flat ring (``2(k-1)/k`` of the payload at DCN speed) whenever
     per > 1 — the full gradient never rides the thin link, only one
     slice-reduced copy does."""
-    per = max(1, int(slice_devices))
-    s = max(1, int(k) // per)
-    rs = nbytes * _ring_factor("reduce-scatter", per) / ici
-    ar = (nbytes / per) * _ring_factor("all-reduce", s) / dcn
-    ag = nbytes * _ring_factor("all-gather", per) / ici
-    return rs + ar + ag
+    ici_s, dcn_s = hierarchical_allreduce_split(
+        nbytes, k, slice_devices, ici, dcn)
+    return ici_s + dcn_s
+
+
+def _group_topology(groups, slice_devices
+                    ) -> Tuple[str, int, int, int]:
+    """Exact replica_groups → (link, k, ns, per).
+
+    ``link`` classifies which wire the collective rides, purely from
+    whether its groups straddle slice boundaries under the slice-major
+    device order build_mesh pins (``device_id // slice_devices`` is
+    the slice index):
+
+    - ``ici``   — every group stays within one slice;
+    - ``dcn``   — groups straddle slices with ONE device per slice
+                  (pure cross-slice traffic, e.g. the staged DCN
+                  all-reduce of the hierarchical exchange);
+    - ``mixed`` — groups straddle slices with >1 device per slice
+                  (the flat lowering's single ring over everything —
+                  how it is priced is the ``exchange`` knob's job).
+
+    ``k`` is the widest group, ``ns`` the most slices any group
+    spans, ``per`` the in-slice device count of a mixed group
+    (``k // ns``).  ``slice_devices`` None/0 = single slice:
+    everything is ICI."""
+    k = max((len(g) for g in groups), default=1)
+    if not slice_devices or int(slice_devices) <= 0:
+        return "ici", k, 1, k
+    per_slice = int(slice_devices)
+    ns, max_per, straddles = 1, 1, False
+    for g in groups:
+        counts: Dict[int, int] = {}
+        for d in g:
+            s = int(d) // per_slice
+            counts[s] = counts.get(s, 0) + 1
+        if counts:
+            ns = max(ns, len(counts))
+            max_per = max(max_per, max(counts.values()))
+        if len(counts) > 1:
+            straddles = True
+    if not straddles:
+        return "ici", k, 1, k
+    if max_per == 1:
+        return "dcn", k, ns, 1
+    return "mixed", k, ns, max(1, k // ns)
+
+
+def classify_group_link(groups, slice_devices) -> str:
+    """replica_groups → "ici" / "dcn" / "mixed" (see
+    :func:`_group_topology` for the rule)."""
+    return _group_topology(groups, slice_devices)[0]
+
+
+def price_collective(opcode: str, nbytes: float, groups,
+                     slice_devices: Optional[int],
+                     ici: float, dcn: float,
+                     exchange: str = "flat"
+                     ) -> Tuple[float, float, float, str, int]:
+    """ONE collective's exact-group price →
+    (seconds, ici_seconds, dcn_seconds, link, group_size).
+
+    The only link decision on any pricing path — there is no opcode
+    heuristic and no ``k > slice_devices`` rule anywhere: an in-slice
+    group prices at ICI however wide it is, a one-device-per-slice
+    group prices at DCN, and a mixed group (straddling with in-slice
+    width) prices per the ``exchange`` knob — ``hierarchical`` as the
+    staged composition (all-reduce: the pinned three-phase
+    ICI-RS/DCN-AR/ICI-AG; other ops: in-slice phase on ICI + the
+    1/per-sized cross-slice phase on DCN), ``flat`` as one ring
+    bounded by the slowest link (the counterfactual the multi-slice
+    gate prices the SAME HLO against)."""
+    link, k, ns, per = _group_topology(groups, slice_devices)
+    if link == "ici":
+        t = nbytes * _ring_factor(opcode, k) / ici
+        return t, t, 0.0, link, k
+    if link == "dcn":
+        t = nbytes * _ring_factor(opcode, k) / dcn
+        return t, 0.0, t, link, k
+    if exchange == "hierarchical":
+        if opcode.startswith("all-reduce"):
+            ici_s, dcn_s = hierarchical_allreduce_split(
+                nbytes, k, per, ici, dcn)
+        else:
+            ici_s = nbytes * _ring_factor(opcode, per) / ici
+            dcn_s = (nbytes / per) * _ring_factor(opcode, ns) / dcn
+        return ici_s + dcn_s, ici_s, dcn_s, link, k
+    t = nbytes * _ring_factor(opcode, k) / dcn
+    return t, 0.0, t, link, k
 
 
 def comm_sizes_for_mesh(mesh_shape: Dict[str, int]) -> Dict[str, int]:
@@ -201,21 +304,36 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
     """Compiled-HLO text → predicted step time for ``target``.
 
     Per-instruction roofline summed per attributed component; see the
-    module docstring for the cost terms.  ``comm_sizes`` prices the
-    collectives (:func:`comm_sizes_for_mesh`); absent, every
-    collective is assumed 2-way — a single-device program has no
-    collectives, so the default only matters when a caller lowered a
-    sharded program and forgot the sizes.  A collective whose ring is
-    wider than ``slice_devices`` crosses a slice boundary: under the
-    default ``exchange="flat"`` its whole ring is priced against the
-    DCN NIC (the slowest link bounds a flat ring); under
-    ``exchange="hierarchical"`` a cross-slice all-reduce is priced as
-    its three phases instead — in-slice reduce-scatter on ICI, DCN
-    all-reduce of the 1/per-slice partials, in-slice all-gather back
-    (:func:`hierarchical_allreduce_seconds`).  ``slice_devices=None``
-    = single slice, everything rides ICI and ``exchange`` is inert —
-    single-slice predictions are bit-identical either way (the banked
-    calibration artifacts depend on that)."""
+    module docstring for the cost terms.  Collectives are priced from
+    their EXACT ``replica_groups`` (attribution.py parses both the
+    explicit and the iota spelling): a group that stays within one
+    slice rides ICI however wide it is, a one-device-per-slice group
+    rides DCN, and a mixed group prices per ``exchange`` —
+    ``hierarchical`` as the staged composition, ``flat`` as one ring
+    at the slowest link (:func:`price_collective`; no opcode
+    heuristic on any pricing path).  A collective line WITHOUT group
+    info (hand-rolled fixtures, ``replica_groups={}``) synthesizes
+    one contiguous group of the sharding-plan size from
+    ``comm_sizes`` (:func:`comm_sizes_for_mesh`; absent, 2-way) and
+    goes through the same group-based path — under slice-major device
+    order a contiguous ring straddles slices exactly when it is wider
+    than one slice, so groupless pricing matches the historical
+    behavior.  ``slice_devices=None`` = single slice, everything
+    rides ICI and ``exchange`` is inert — single-slice predictions
+    are bit-identical either way (the banked calibration artifacts
+    depend on that).
+
+    Besides the totals the prediction carries the communication
+    observatory: ``collectives`` (one identity row per priced
+    collective — opcode, payload, group topology, link class,
+    component, per-link ms, exposed ms) and ``comms_ms`` (the
+    ici/dcn/exposed rollup).  Exposed time walks each async
+    ``*-start``/``*-done`` pair against the non-collective compute
+    scheduled between them: what fits in that window is overlappable,
+    the rest is exposed on the critical path; a sync collective (no
+    start/done — every CPU lowering) is fully exposed.  The
+    ``exposed_dcn_ms`` figure is the hermetic before/after metric for
+    a future DCN-overlap optimization."""
     spec = chip_spec(target)
     peak = float(spec["peak_flops"].get(precision)
                  or spec["peak_flops"]["bfloat16"])
@@ -230,6 +348,9 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
     comp_sec: Dict[str, float] = {}
     comp_costs: Dict[str, Dict[str, float]] = {}
     totals = {"flops": 0.0, "hbm_bytes": 0.0, "collective_bytes": 0.0}
+    own_sec: Dict[str, float] = {}   # per-instruction seconds
+    ledger: List[Dict[str, Any]] = []          # per-collective rows
+    ledger_by_name: Dict[str, Dict[str, Any]] = {}
     for instrs in attr.comps.values():
         for ins in instrs:
             if ins.cost <= 0:
@@ -237,29 +358,108 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
             comp = attr.instr_component.get(ins.name) or "other"
             row = comp_costs.setdefault(
                 comp, {"flops": 0.0, "bytes": 0.0,
-                       "collective_bytes": 0.0})
+                       "collective_bytes": 0.0,
+                       "ici_ms": 0.0, "dcn_ms": 0.0})
             if is_collective_opcode(ins.opcode):
-                k = _comm_k(comm_sizes, ins.opcode)
-                crosses = (slice_devices is not None
-                           and k > int(slice_devices))
-                if (crosses and exchange == "hierarchical"
-                        and ins.opcode.startswith("all-reduce")):
-                    t = hierarchical_allreduce_seconds(
-                        ins.bytes, k, int(slice_devices), ici, dcn)
-                else:
-                    # the slowest link bounds a flat ring: DCN once it
-                    # spans more devices than one slice holds
-                    bw = dcn if crosses else ici
-                    t = ins.bytes * _ring_factor(ins.opcode, k) / bw
+                groups, src = ins.groups, "hlo"
+                if not groups:
+                    # groupless line: ONE contiguous group of the
+                    # plan size, through the same group-based path
+                    groups = (tuple(range(
+                        _comm_k(comm_sizes, ins.opcode))),)
+                    src = "synthesized"
+                t, ici_s, dcn_s, link, k = price_collective(
+                    ins.opcode, ins.bytes, groups, slice_devices,
+                    ici, dcn, exchange=exchange)
                 totals["collective_bytes"] += ins.bytes
                 row["collective_bytes"] += ins.bytes
+                row["ici_ms"] += ici_s * 1e3
+                row["dcn_ms"] += dcn_s * 1e3
+                lrow = {
+                    "name": ins.name, "opcode": ins.opcode,
+                    "component": comp, "bytes": int(ins.bytes),
+                    "group_size": k, "num_groups": len(groups),
+                    "link": link, "groups_source": src,
+                    "predicted_ms": t * 1e3,
+                    "ici_ms": ici_s * 1e3, "dcn_ms": dcn_s * 1e3,
+                    # sync until a matching *-done proves otherwise
+                    "overlap_ms": 0.0, "exposed_ms": t * 1e3,
+                }
+                ledger.append(lrow)
+                ledger_by_name[ins.name] = lrow
             else:
                 t = max(ins.flops / peak, ins.bytes / hbm)
                 totals["flops"] += ins.flops
                 totals["hbm_bytes"] += ins.bytes
                 row["flops"] += ins.flops
                 row["bytes"] += ins.bytes
+            own_sec[ins.name] = t
             comp_sec[comp] = comp_sec.get(comp, 0.0) + t
+
+    # ---- exposed-comms walk ------------------------------------------
+    # Per-computation seconds (bottom-up, cycle-guarded) so a fusion /
+    # while between a *-start and its *-done contributes its REAL
+    # modeled time to the overlap window, not its zero container cost.
+    comp_total: Dict[str, float] = {}
+
+    def _comp_seconds(cname: str, _stack=()) -> float:
+        if cname in comp_total:
+            return comp_total[cname]
+        if cname in _stack or cname not in attr.comps:
+            return 0.0
+        tot = 0.0
+        for i in attr.comps[cname]:
+            tot += own_sec.get(i.name, 0.0)
+            for callee in i.calls:
+                tot += _comp_seconds(callee, _stack + (cname,))
+        comp_total[cname] = tot
+        return tot
+
+    for instrs in attr.comps.values():
+        open_windows: Dict[str, float] = {}
+        for ins in instrs:
+            if (ins.opcode.endswith("-start")
+                    and ins.name in ledger_by_name):
+                open_windows[ins.name] = 0.0
+            elif ins.opcode.endswith("-done"):
+                for op in ins.operands:
+                    if op in open_windows:
+                        window = open_windows.pop(op)
+                        lrow = ledger_by_name[op]
+                        t = lrow["predicted_ms"]
+                        lrow["overlap_ms"] = min(window * 1e3, t)
+                        lrow["exposed_ms"] = max(
+                            0.0, t - window * 1e3)
+                        break
+            elif not is_collective_opcode(ins.opcode):
+                # only independent compute overlaps a collective;
+                # another collective would contend for the same link
+                spend = own_sec.get(ins.name, 0.0) + sum(
+                    _comp_seconds(c) for c in ins.calls)
+                if spend > 0:
+                    for name in open_windows:
+                        open_windows[name] += spend
+        # a *-start with no *-done in this computation stays fully
+        # exposed (the conservative reading of a truncated artifact)
+
+    comms_ms = {"ici_ms": 0.0, "dcn_ms": 0.0,
+                "exposed_ms": 0.0, "exposed_dcn_ms": 0.0}
+    for lrow in ledger:
+        comms_ms["ici_ms"] += lrow["ici_ms"]
+        comms_ms["dcn_ms"] += lrow["dcn_ms"]
+        comms_ms["exposed_ms"] += lrow["exposed_ms"]
+        if lrow["predicted_ms"] > 0:
+            comms_ms["exposed_dcn_ms"] += (
+                lrow["exposed_ms"]
+                * lrow["dcn_ms"] / lrow["predicted_ms"])
+        for key in ("predicted_ms", "ici_ms", "dcn_ms",
+                    "overlap_ms", "exposed_ms"):
+            lrow[key] = round(lrow[key], 4)
+    ledger.sort(key=lambda r: (-r["exposed_ms"], -r["predicted_ms"],
+                               r["name"]))
+    for crow in comp_costs.values():
+        crow["ici_ms"] = round(crow["ici_ms"], 4)
+        crow["dcn_ms"] = round(crow["dcn_ms"], 4)
 
     components_ms = {c: round(t * 1e3, 4) for c, t in
                      sorted(comp_sec.items(), key=lambda kv: -kv[1])}
@@ -276,6 +476,8 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
                         sections_ms.items()},
         "components_ms": components_ms,
         "component_costs": comp_costs,
+        "comms_ms": {k: round(v, 4) for k, v in comms_ms.items()},
+        "collectives": ledger,
         "totals": {k: round(v, 1) for k, v in totals.items()},
         "comm_sizes": dict(comm_sizes),
     }
@@ -740,13 +942,36 @@ def calibrate(points: List[Dict]) -> Dict[str, Any]:
     return out
 
 
+#: per-link communication gauges published next to PREDICTED_GAUGE —
+#: prediction comms_ms key → (gauge name, help)
+PREDICTED_COMMS_GAUGES = {
+    "ici_ms": ("eksml_train_predicted_comms_ici_ms",
+               "roofline-predicted per-step collective time on the "
+               "in-slice ICI links (replica_groups-exact pricing)"),
+    "dcn_ms": ("eksml_train_predicted_comms_dcn_ms",
+               "roofline-predicted per-step collective time on the "
+               "cross-slice DCN links (replica_groups-exact pricing)"),
+    "exposed_ms": ("eksml_train_predicted_comms_exposed_ms",
+                   "predicted collective time NOT hidden behind "
+                   "compute scheduled inside async start/done "
+                   "windows — the overlap headroom metric"),
+}
+
+
 def publish_predicted_gauge(pred: Dict[str, Any]) -> None:
-    """Set the ``eksml_train_predicted_step_time_ms`` gauge from a
-    prediction — ONE definition of name + help for trainer and tests."""
+    """Set the ``eksml_train_predicted_step_time_ms`` gauge — plus the
+    per-link communication gauges when the prediction carries the
+    observatory rollup — from a prediction.  ONE definition of names +
+    help for trainer and tests."""
     from eksml_tpu import telemetry
 
-    telemetry.default_registry().gauge(
+    reg = telemetry.default_registry()
+    reg.gauge(
         PREDICTED_GAUGE,
         "roofline-predicted step time for this run's compiled train "
         "step on the target chip (eksml_tpu/profiling/predict.py)"
     ).set(float(pred["predicted_step_time_ms"]))
+    comms = pred.get("comms_ms")
+    if comms:
+        for key, (name, help_text) in PREDICTED_COMMS_GAUGES.items():
+            reg.gauge(name, help_text).set(float(comms.get(key, 0.0)))

@@ -1288,12 +1288,16 @@ class Trainer:
                     num_slices=int(self.cfg.TPU.NUM_SLICES))
                 predict_mod.publish_predicted_gauge(pred)
                 s = pred["sections_ms"]
+                c = pred.get("comms_ms") or {}
                 log.info(
                     "predicted step time (%s roofline): %.2f ms "
                     "(fwd %.2f / bwd %.2f / comms %.2f / "
-                    "optimizer %.2f)",
+                    "optimizer %.2f; comms ici %.2f / dcn %.2f / "
+                    "exposed %.2f)",
                     pred["target"], pred["predicted_step_time_ms"],
-                    s["fwd"], s["bwd"], s["comms"], s["optimizer"])
+                    s["fwd"], s["bwd"], s["comms"], s["optimizer"],
+                    c.get("ici_ms", 0.0), c.get("dcn_ms", 0.0),
+                    c.get("exposed_ms", 0.0))
             except Exception:  # noqa: BLE001 — observability only
                 # the AOT compile is already paid: keep dispatching
                 # it even when the pricing half fell over

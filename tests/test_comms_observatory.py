@@ -1,0 +1,487 @@
+"""The communication observatory (ISSUE 19).
+
+Three layers, cheapest first:
+- replica_groups parsing (explicit / iota±transpose /
+  source_target_pairs spellings) and the slice-straddle link
+  classification, pure text + index arithmetic;
+- replica_groups-exact pricing pinned BOTH directions of the old
+  ``k > slice_devices`` mispricing on a hand-rolled two-slice module
+  (an in-slice group wider than the comm-table size must ride ICI, a
+  straddling group must ride DCN / the hierarchical composition),
+  plus the exposed-comms walk over async ``*-start``/``*-done``
+  windows;
+- the surfaced views: predicted per-link gauges, the run_report
+  "Communication" section rendered from the committed bank (and from
+  one real 2-slice hierarchical lowering, slow-marked) with its
+  pointer degradation.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eksml_tpu.profiling import attribution as A
+from eksml_tpu.profiling import predict as P
+
+V5E = P.chip_spec("v5e")
+ICI = float(V5E["ici_bytes_per_sec"])
+DCN = float(V5E["dcn_bytes_per_sec"])
+
+
+# ---- replica_groups parsing ------------------------------------------
+
+
+def test_parse_explicit_groups():
+    groups = A.parse_collective_groups(
+        "  %all-gather.1 = f32[8]{0} all-gather(f32[4]{0} %p0), "
+        "replica_groups={{0,1},{4,5},{2,3},{6,7}}, dimensions={0}")
+    assert groups == ((0, 1), (4, 5), (2, 3), (6, 7))
+
+
+def test_parse_iota_groups_no_transpose():
+    # [2,4]<=[8]: identity iota, contiguous quads
+    groups = A.parse_collective_groups("replica_groups=[2,4]<=[8]")
+    assert groups == ((0, 1, 2, 3), (4, 5, 6, 7))
+    # [1,8]<=[8]: the flat whole-world ring XLA emits for grad
+    # all-reduces under the 2-slice lowering
+    groups = A.parse_collective_groups("replica_groups=[1,8]<=[8]")
+    assert groups == ((0, 1, 2, 3, 4, 5, 6, 7),)
+
+
+def test_parse_iota_groups_with_transpose():
+    # the dominant all-gather form in the real 2-slice 2d lowering:
+    # iota(8)→[2,2,2]→T(0,2,1)→[4,2]; pairs devices {0,2},{1,3},...
+    groups = A.parse_collective_groups(
+        "replica_groups=[4,2]<=[2,2,2]T(0,2,1)")
+    assert groups == ((0, 2), (1, 3), (4, 6), (5, 7))
+    # T(1,0): plain transpose of an [4,2] iota
+    groups = A.parse_collective_groups(
+        "replica_groups=[4,2]<=[4,2]T(1,0)")
+    assert groups == ((0, 2), (4, 6), (1, 3), (5, 7))
+
+
+def test_parse_source_target_pairs():
+    groups = A.parse_collective_groups(
+        "%collective-permute.1 = f32[4]{0} collective-permute("
+        "f32[4]{0} %p0), source_target_pairs={{0,1},{1,2},{2,3},{3,0}}")
+    assert groups == ((0, 1), (1, 2), (2, 3), (3, 0))
+
+
+def test_parse_no_group_info_is_none():
+    # the groupless spellings callers must synthesize for
+    assert A.parse_collective_groups("replica_groups={}") is None
+    assert A.parse_collective_groups(
+        "%all-reduce.3 = f32[8]{0} all-reduce(f32[8]{0} %x), "
+        "to_apply=%add.1") is None
+
+
+def test_parse_hlo_attaches_groups_to_collectives_only():
+    comps, entry = A.parse_hlo(MISPRICING_FIXTURE)
+    by_name = {i.name: i for instrs in comps.values() for i in instrs}
+    assert by_name["all-gather.2"].groups == (
+        (0, 1, 2, 3), (4, 5, 6, 7))
+    assert by_name["all-reduce.3"].groups == (
+        (0, 4), (1, 5), (2, 6), (3, 7))
+    assert by_name["copy.4"].groups is None
+
+
+# ---- link classification ---------------------------------------------
+
+
+def test_classify_group_link():
+    sd = 4  # slice-major: devices 0-3 slice 0, 4-7 slice 1
+    assert P.classify_group_link(((0, 1, 2, 3), (4, 5, 6, 7)),
+                                 sd) == "ici"
+    assert P.classify_group_link(((0, 4), (1, 5)), sd) == "dcn"
+    assert P.classify_group_link(((0, 1, 2, 3, 4, 5, 6, 7),),
+                                 sd) == "mixed"
+    # single slice: everything rides ICI, however the groups look
+    assert P.classify_group_link(((0, 1, 2, 3, 4, 5, 6, 7),),
+                                 None) == "ici"
+    assert P.classify_group_link(((0, 1), (2, 3)), None) == "ici"
+
+
+def test_group_topology_fields():
+    link, k, ns, per = P._group_topology(
+        ((0, 1, 2, 3, 4, 5, 6, 7),), 4)
+    assert (link, k, ns, per) == ("mixed", 8, 2, 4)
+    link, k, ns, per = P._group_topology(((0, 4), (1, 5)), 4)
+    assert (link, k, ns, per) == ("dcn", 2, 2, 1)
+    link, k, ns, per = P._group_topology(((0, 2), (1, 3)), 2)
+    assert (link, k, ns, per) == ("dcn", 2, 2, 1)
+
+
+# ---- the mispricing regression, both directions (satellite a) --------
+#
+# 8 devices, slice_devices=4 (two slices).  The comm-sizes table
+# deliberately says 8 for everything: under the old
+# ``k > slice_devices`` opcode heuristic BOTH collectives below would
+# have priced as cross-slice.  With exact groups, the all-gather's
+# groups stay inside one slice (ICI however wide the table claims)
+# and the all-reduce's one-device-per-slice groups ride DCN.
+
+MISPRICING_FIXTURE = """\
+HloModule jit_step, entry_computation_layout={()->f32[8]{0}}
+
+%add.1 (x.0: f32[], y.0: f32[]) -> f32[] {
+  %x.0 = f32[] parameter(0)
+  %y.0 = f32[] parameter(1)
+  ROOT %add.2 = f32[] add(f32[] %x.0, f32[] %y.0)
+}
+
+ENTRY %main.9 (Arg_0.1: f32[256,1024]) -> f32[1024,1024] {
+  %Arg_0.1 = f32[256,1024]{1,0} parameter(0)
+  %all-gather.2 = f32[1024,1024]{1,0} all-gather(f32[256,1024]{1,0} %Arg_0.1), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}
+  %all-reduce.3 = f32[1024,1024]{1,0} all-reduce(f32[1024,1024]{1,0} %all-gather.2), replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=%add.1
+  ROOT %copy.4 = f32[1024,1024]{1,0} copy(f32[1024,1024]{1,0} %all-reduce.3)
+}
+"""
+
+# shape tokens on the line sum into the payload: out + operand
+AG_BYTES = (1024 * 1024 + 256 * 1024) * 4
+AR_BYTES = (1024 * 1024 + 1024 * 1024) * 4
+
+
+def _mispricing_pred(exchange="hierarchical"):
+    return P.predict_from_hlo(
+        MISPRICING_FIXTURE, target="v5e", precision="float32",
+        comm_sizes={"all-": 8, "reduce-scatter": 8,
+                    "collective-permute": 8},
+        slice_devices=4, exchange=exchange)
+
+
+def test_in_slice_group_wider_than_table_rides_ici():
+    pred = _mispricing_pred()
+    rows = {r["name"]: r for r in pred["collectives"]}
+    ag = rows["all-gather.2"]
+    assert ag["link"] == "ici"
+    assert ag["groups_source"] == "hlo"
+    assert ag["group_size"] == 4 and ag["num_groups"] == 2
+    assert ag["bytes"] == AG_BYTES
+    # priced purely from the groups: 4-ring over ICI, zero DCN —
+    # the comm-table k=8 (> slice_devices) is never consulted
+    assert ag["dcn_ms"] == 0.0
+    # ledger values are rounded to 4dp when banked
+    assert ag["ici_ms"] == pytest.approx(
+        AG_BYTES * (3.0 / 4.0) / ICI * 1e3, abs=1e-4)
+    assert ag["predicted_ms"] == ag["ici_ms"]
+
+
+def test_straddling_group_rides_dcn():
+    pred = _mispricing_pred()
+    rows = {r["name"]: r for r in pred["collectives"]}
+    ar = rows["all-reduce.3"]
+    assert ar["link"] == "dcn"
+    assert ar["group_size"] == 2 and ar["num_groups"] == 4
+    assert ar["ici_ms"] == 0.0
+    # one-device-per-slice 2-ring: all-reduce factor 2(k-1)/k = 1
+    assert ar["dcn_ms"] == pytest.approx(
+        AR_BYTES * 1.0 / DCN * 1e3, abs=1e-3)
+    # the DCN leg dwarfs the in-slice all-gather despite the smaller
+    # ring — the whole point of pricing the link, not the opcode
+    assert ar["dcn_ms"] > rows["all-gather.2"]["ici_ms"]
+
+
+def test_exchange_knob_only_governs_mixed_groups():
+    # ici and dcn groups price identically under either exchange;
+    # only a mixed (straddling, >1 per slice) group differs
+    hier = _mispricing_pred("hierarchical")
+    flat = _mispricing_pred("flat")
+    assert hier["collectives"] == flat["collectives"]
+    assert (hier["predicted_step_time_ms"]
+            == flat["predicted_step_time_ms"])
+
+
+def test_mixed_group_prices_per_exchange():
+    groups = ((0, 1, 2, 3, 4, 5, 6, 7),)
+    nbytes = 8 * 2 ** 20
+    t_h, ici_h, dcn_h, link, k = P.price_collective(
+        "all-reduce", nbytes, groups, 4, ICI, DCN,
+        exchange="hierarchical")
+    assert (link, k) == ("mixed", 8)
+    # the staged composition is exactly the pinned three-phase split
+    ici_s, dcn_s = P.hierarchical_allreduce_split(nbytes, 8, 4,
+                                                  ICI, DCN)
+    assert ici_h == pytest.approx(ici_s, rel=1e-12)
+    assert dcn_h == pytest.approx(dcn_s, rel=1e-12)
+    assert t_h == pytest.approx(ici_s + dcn_s, rel=1e-12)
+    # flat: the same ring priced entirely at the slowest link
+    t_f, ici_f, dcn_f, _, _ = P.price_collective(
+        "all-reduce", nbytes, groups, 4, ICI, DCN, exchange="flat")
+    assert ici_f == 0.0
+    assert t_f == pytest.approx(
+        nbytes * (2.0 * 7 / 8) / DCN, rel=1e-12)
+    assert t_h < t_f
+    # non-all-reduce mixed op: in-slice phase + 1/per cross phase
+    t_g, ici_g, dcn_g, _, _ = P.price_collective(
+        "all-gather", nbytes, groups, 4, ICI, DCN,
+        exchange="hierarchical")
+    assert ici_g == pytest.approx(nbytes * (3.0 / 4.0) / ICI,
+                                  rel=1e-12)
+    assert dcn_g == pytest.approx((nbytes / 4) * (1.0 / 2.0) / DCN,
+                                  rel=1e-12)
+    assert t_g == pytest.approx(ici_g + dcn_g, rel=1e-12)
+
+
+def test_groupless_line_synthesizes_contiguous_group():
+    # replica_groups={} (or a hand-rolled fixture) falls back to ONE
+    # contiguous group of the comm-table size — which under
+    # slice-major order straddles exactly when wider than one slice,
+    # reproducing the historical behavior through the group path
+    hlo = MISPRICING_FIXTURE.replace(
+        "replica_groups={{0,4},{1,5},{2,6},{3,7}}",
+        "replica_groups={}")
+    pred = P.predict_from_hlo(
+        hlo, target="v5e", precision="float32",
+        comm_sizes={"all-": 4}, slice_devices=2,
+        exchange="hierarchical")
+    rows = {r["name"]: r for r in pred["collectives"]}
+    ar = rows["all-reduce.3"]
+    assert ar["groups_source"] == "synthesized"
+    assert ar["group_size"] == 4
+    assert ar["link"] == "mixed"        # (0,1,2,3) straddles sd=2
+    assert ar["ici_ms"] > 0 and ar["dcn_ms"] > 0
+    # the explicit-groups line still reads its own groups
+    assert rows["all-gather.2"]["groups_source"] == "hlo"
+
+
+# ---- exposed-comms walk ----------------------------------------------
+
+_ASYNC_TMPL = """\
+HloModule jit_step, entry_computation_layout={{()->f32[8]{{0}}}}
+
+%add.1 (x.0: f32[], y.0: f32[]) -> f32[] {{
+  %x.0 = f32[] parameter(0)
+  %y.0 = f32[] parameter(1)
+  ROOT %add.2 = f32[] add(f32[] %x.0, f32[] %y.0)
+}}
+
+ENTRY %main.9 (Arg_0.1: f32[1024,1024]) -> f32[1024,1024] {{
+  %Arg_0.1 = f32[1024,1024]{{1,0}} parameter(0)
+  %all-reduce-start.2 = f32[1024,1024]{{1,0}} all-reduce-start(f32[1024,1024]{{1,0}} %Arg_0.1), replica_groups={{{{0,1}}}}, to_apply=%add.1
+{between}
+  %all-reduce-done.5 = f32[1024,1024]{{1,0}} all-reduce-done(f32[1024,1024]{{1,0}} %all-reduce-start.2)
+  ROOT %copy.8 = f32[1024,1024]{{1,0}} copy(f32[1024,1024]{{1,0}} %all-reduce-done.5)
+}}
+"""
+
+_BIG_CONV = ("  %convolution.3 = f32[4096,4096]{1,0} convolution("
+             "f32[4096,4096]{1,0} %Arg_0.1, f32[4096,4096]{1,0} "
+             "%Arg_0.1), window={size=1x1}, dim_labels=bf01_oi01"
+             "->bf01")
+_SMALL_MUL = ("  %multiply.3 = f32[1024,1024]{1,0} multiply("
+              "f32[1024,1024]{1,0} %Arg_0.1, f32[1024,1024]{1,0} "
+              "%Arg_0.1)")
+
+
+def _async_pred(between):
+    return P.predict_from_hlo(
+        _ASYNC_TMPL.format(between=between), target="v5e",
+        precision="float32", comm_sizes={"all-": 2},
+        slice_devices=None)
+
+
+def test_async_collective_hidden_behind_big_compute():
+    pred = _async_pred(_BIG_CONV)
+    (row,) = pred["collectives"]
+    assert row["opcode"] == "all-reduce-start"
+    # the conv window exceeds the collective: fully overlapped
+    assert row["exposed_ms"] == 0.0
+    assert row["overlap_ms"] == row["predicted_ms"]
+    assert pred["comms_ms"]["exposed_ms"] == 0.0
+
+
+def test_async_collective_partially_exposed_behind_small_compute():
+    # an HBM-bound multiply hides ~1/3 of the 2-ring all-reduce: the
+    # rest is exposed
+    pred = _async_pred(_SMALL_MUL)
+    (row,) = pred["collectives"]
+    assert 0.0 < row["exposed_ms"] < row["predicted_ms"]
+    assert row["overlap_ms"] > 0.0
+    assert (row["overlap_ms"] + row["exposed_ms"]
+            == pytest.approx(row["predicted_ms"], abs=1e-3))
+
+
+def test_sync_and_unmatched_collectives_fully_exposed():
+    # a plain (sync) all-reduce exposes its whole price
+    pred = P.predict_from_hlo(
+        MISPRICING_FIXTURE, target="v5e", precision="float32",
+        comm_sizes={"all-": 8}, slice_devices=4,
+        exchange="hierarchical")
+    for row in pred["collectives"]:
+        assert row["overlap_ms"] == 0.0
+        assert row["exposed_ms"] == row["predicted_ms"]
+    # a *-start with no matching *-done stays fully exposed too
+    hlo = _ASYNC_TMPL.format(between=_BIG_CONV)
+    hlo = "\n".join(l for l in hlo.splitlines()
+                    if "all-reduce-done" not in l
+                    and not l.startswith("  ROOT"))
+    pred = P.predict_from_hlo(hlo, target="v5e", precision="float32",
+                              comm_sizes={"all-": 2})
+    (row,) = pred["collectives"]
+    assert row["exposed_ms"] == row["predicted_ms"]
+
+
+def test_fusion_between_start_done_counts_callee_time():
+    # the compute hiding the collective sits INSIDE a fusion — the
+    # walk must credit the called computation's modeled seconds, not
+    # the container's zero cost
+    hlo = """\
+HloModule jit_step, entry_computation_layout={()->f32[8]{0}}
+
+%add.1 (x.0: f32[], y.0: f32[]) -> f32[] {
+  %x.0 = f32[] parameter(0)
+  %y.0 = f32[] parameter(1)
+  ROOT %add.2 = f32[] add(f32[] %x.0, f32[] %y.0)
+}
+
+%fused_computation (param_0.1: f32[4096,4096]) -> f32[4096,4096] {
+  %param_0.1 = f32[4096,4096]{1,0} parameter(0)
+  ROOT %convolution.1 = f32[4096,4096]{1,0} convolution(f32[4096,4096]{1,0} %param_0.1, f32[4096,4096]{1,0} %param_0.1), window={size=1x1}, dim_labels=bf01_oi01->bf01
+}
+
+ENTRY %main.9 (Arg_0.1: f32[1024,1024]) -> f32[1024,1024] {
+  %Arg_0.1 = f32[1024,1024]{1,0} parameter(0)
+  %all-reduce-start.2 = f32[1024,1024]{1,0} all-reduce-start(f32[1024,1024]{1,0} %Arg_0.1), replica_groups={{0,1}}, to_apply=%add.1
+  %fusion.3 = f32[1024,1024]{1,0} fusion(f32[1024,1024]{1,0} %Arg_0.1), kind=kLoop, calls=%fused_computation
+  %all-reduce-done.5 = f32[1024,1024]{1,0} all-reduce-done(f32[1024,1024]{1,0} %all-reduce-start.2)
+  ROOT %copy.8 = f32[1024,1024]{1,0} copy(f32[1024,1024]{1,0} %all-reduce-done.5)
+}
+"""
+    pred = P.predict_from_hlo(hlo, target="v5e", precision="float32",
+                              comm_sizes={"all-": 2})
+    (row,) = pred["collectives"]
+    assert row["exposed_ms"] == 0.0
+    assert row["overlap_ms"] == row["predicted_ms"]
+
+
+# ---- the rollup + component split ------------------------------------
+
+
+def test_comms_rollup_and_component_split():
+    pred = _mispricing_pred()
+    rows = pred["collectives"]
+    c = pred["comms_ms"]
+    assert c["ici_ms"] == pytest.approx(
+        sum(r["ici_ms"] for r in rows), abs=1e-3)
+    assert c["dcn_ms"] == pytest.approx(
+        sum(r["dcn_ms"] for r in rows), abs=1e-3)
+    assert c["exposed_ms"] == pytest.approx(
+        sum(r["exposed_ms"] for r in rows), abs=1e-3)
+    # everything here is sync, so exposed-DCN equals the DCN total
+    assert c["exposed_dcn_ms"] == pytest.approx(c["dcn_ms"], abs=1e-3)
+    # the comms section covers at least the ledger (it can exceed it:
+    # neighbor inheritance attributes metadata-less ops next to a
+    # collective — the ROOT copy here — into the allreduce component)
+    assert (pred["sections_ms"]["comms"] + 1e-3
+            >= sum(r["predicted_ms"] for r in rows))
+    # component_costs carries the per-link split alongside the bytes
+    costs = pred["component_costs"]["allreduce"]
+    assert costs["ici_ms"] == pytest.approx(c["ici_ms"], abs=1e-3)
+    assert costs["dcn_ms"] == pytest.approx(c["dcn_ms"], abs=1e-3)
+    assert costs["collective_bytes"] == AG_BYTES + AR_BYTES
+    # worst-exposed-first ordering (the overlap PR reads the top row)
+    assert rows == sorted(rows, key=lambda r: (-r["exposed_ms"],
+                                               -r["predicted_ms"],
+                                               r["name"]))
+
+
+# ---- the predicted comms gauges --------------------------------------
+
+
+def test_publish_predicted_gauge_sets_comms_gauges():
+    from eksml_tpu import telemetry
+
+    P.publish_predicted_gauge({
+        "predicted_step_time_ms": 5.0,
+        "comms_ms": {"ici_ms": 1.25, "dcn_ms": 2.5,
+                     "exposed_ms": 0.75, "exposed_dcn_ms": 0.5}})
+    reg = telemetry.default_registry()
+    assert reg.get(P.PREDICTED_GAUGE).value == 5.0
+    assert reg.get(
+        "eksml_train_predicted_comms_ici_ms").value == 1.25
+    assert reg.get(
+        "eksml_train_predicted_comms_dcn_ms").value == 2.5
+    assert reg.get(
+        "eksml_train_predicted_comms_exposed_ms").value == 0.75
+    # a prediction without the rollup (serve path, old artifacts)
+    # still publishes the main gauge and leaves comms untouched
+    P.publish_predicted_gauge({"predicted_step_time_ms": 7.0})
+    assert reg.get(P.PREDICTED_GAUGE).value == 7.0
+    assert reg.get(
+        "eksml_train_predicted_comms_ici_ms").value == 1.25
+
+
+# ---- run_report "Communication" section (satellite d) ----------------
+
+
+def test_comms_section_degrades_to_pointer(tmp_path):
+    from tools import run_report
+
+    text = "\n".join(run_report._comms_section(str(tmp_path)))
+    assert "## Communication" in text
+    assert "perf_gate.py --update-baseline" in text
+    assert str(tmp_path) in text
+
+
+def test_comms_section_renders_committed_bank():
+    from tools import run_report
+
+    artifacts = os.path.join(REPO, "artifacts")
+    text = "\n".join(run_report._comms_section(artifacts))
+    # the banked multi-slice rungs appear with per-link columns
+    assert "| 128_b1_s2_2d_bfloat16 |" in text
+    assert "| 128_b1_s4_2d_bfloat16 |" in text
+    assert "Top exposed collectives" in text
+    # the committed 2-slice hierarchical prediction carries nonzero
+    # exposed DCN — the hermetic headroom metric the overlap PR
+    # will drive down
+    with open(os.path.join(
+            artifacts, "perf_pred_128_b1_s2_2d_bfloat16.json")) as f:
+        rec = json.load(f)
+    assert rec["comms_ms"]["exposed_dcn_ms"] > 0
+    assert rec["comms_ms"]["dcn_ms"] > 0
+    assert rec["collectives"], "banked ledger must not be empty"
+    # its dominant exposed collective is named in the report table
+    worst = rec["collectives"][0]
+    assert worst["exposed_ms"] > 0
+    assert f"| {worst['name']} " in text
+
+
+def test_banked_multislice_artifacts_carry_the_ledger():
+    # every banked multi-slice prediction prices some traffic on DCN
+    # and classifies the dominant grad all-reduce as mixed (the flat
+    # [1,N]<=[N] ring straddles slices with >1 device per slice)
+    for key in ("128_b1_s2_2d_bfloat16", "128_b1_s4_2d_bfloat16"):
+        with open(os.path.join(
+                REPO, "artifacts", f"perf_pred_{key}.json")) as f:
+            rec = json.load(f)
+        links = {r["link"] for r in rec["collectives"]}
+        assert "mixed" in links or "dcn" in links
+        assert all(r["groups_source"] == "hlo"
+                   for r in rec["collectives"])
+        assert rec["comms_ms"]["ici_ms"] > 0
+
+
+@pytest.mark.slow
+def test_real_two_slice_lowering_drives_the_section(tmp_path):
+    # satellite (d) end-to-end: lower the REAL 2-slice hierarchical
+    # train step, bank the prediction, and render the Communication
+    # section from it — it must name a dominant exposed collective
+    from eksml_tpu.fsio import atomic_write_json
+    from tools import perf_gate, run_report
+
+    rec = perf_gate.predict_rung("128_b1_s2", "2d", "bfloat16", "v5e")
+    assert rec["comms_ms"]["exposed_dcn_ms"] > 0
+    atomic_write_json(
+        str(tmp_path / "perf_pred_128_b1_s2_2d_bfloat16.json"), rec)
+    text = "\n".join(run_report._comms_section(str(tmp_path)))
+    assert "Top exposed collectives" in text
+    worst = rec["collectives"][0]
+    assert f"| {worst['name']} " in text
+    assert worst["link"] in ("mixed", "dcn", "ici")

@@ -102,6 +102,12 @@
 # 2-slice 8-chip run mid-epoch, elastically resume single-slice at 4
 # chips (flat exchange — one slice has no DCN hop), then grow back
 # to 2 slices — every crossing resharded, the loss stream continuous.
+# unit-comms covers the communication observatory (ISSUE 19): the
+# replica_groups parser (explicit + iota forms, source_target_pairs),
+# the ici/dcn/mixed link classification from slice straddling (no
+# opcode heuristic on any pricing path), the per-collective ledger +
+# comms_ms rollup, the exposed-time start/done walk, and the
+# run_report Communication section with its pointer degradation.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # (or `-m eksml_tpu.serve`) processes and are marked slow (excluded
 # from tier-1); the unit and data-* rungs run in seconds.  Everything runs under
@@ -132,6 +138,7 @@ RUNGS=(
   "unit-sharding-2d|tests/test_sharding.py -k 'tensor or 2d'"
   "unit-multislice|tests/test_sharding.py tests/test_parallel.py tests/test_perf_gate.py -k 'slice or hierarchical or multislice'"
   "unit-perfgate|tests/test_perf_gate.py"
+  "unit-comms|tests/test_comms_observatory.py"
   "unit-serve|tests/test_serve.py"
   "unit-serve-reload|tests/test_serve_reload.py"
   "unit-autoscale|tests/test_autoscale.py"

@@ -304,6 +304,11 @@ def gate_one(fresh: Dict, bank_dir: str, max_regress_pct: float,
         "sections_ms": fresh["sections_ms"],
         "baseline_path": os.path.relpath(path, REPO),
     }
+    if fresh.get("comms_ms") is not None:
+        # the per-link communication columns (ISSUE 19): predicted
+        # ici/dcn/exposed ms ride every verdict row so a comms move
+        # is visible at the link level, not just inside the total
+        row["comms_ms"] = fresh["comms_ms"]
     widths = row_axis_widths(fresh)
     if widths is not None:
         # resolved shard widths ride every verdict row: a 2d rung and
@@ -526,6 +531,8 @@ def main(argv=None) -> int:
                         fresh["predicted_step_time_ms"],
                     "sections_ms": fresh["sections_ms"],
                     "baseline_path": os.path.relpath(path, REPO)}
+                if fresh.get("comms_ms") is not None:
+                    banked_row["comms_ms"] = fresh["comms_ms"]
                 widths = row_axis_widths(fresh)
                 if widths is not None:
                     banked_row["axis_widths"] = widths

@@ -909,6 +909,75 @@ def _predicted_section(artifacts_dir: Optional[str]) -> List[str]:
     return lines
 
 
+def _comms_section(artifacts_dir: Optional[str]) -> List[str]:
+    """Communication observatory (ISSUE 19): per-link totals and the
+    top exposed collectives from the per-collective ledgers banked
+    inside ``perf_pred_*`` artifacts — degrading to a pointer exactly
+    like the predicted-step-time table when no banked prediction
+    carries a ledger yet."""
+    lines = ["## Communication (predicted per-collective ledger)"]
+    if artifacts_dir is None:
+        artifacts_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))), "artifacts")
+    preds = sorted(glob.glob(os.path.join(artifacts_dir,
+                                          "perf_pred_*.json")))
+    preds = [p for p in preds if not os.path.basename(p)
+             .startswith("perf_pred_serve_")]
+    recs = []
+    for path in preds:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        if rec.get("comms_ms") and rec.get("collectives"):
+            recs.append((rec.get("key", os.path.basename(path)), rec))
+    if not recs:
+        lines += ["", "No banked prediction carries a per-collective "
+                      f"ledger in `{artifacts_dir}` — run `python "
+                      "tools/perf_gate.py --update-baseline` to bank "
+                      "replica_groups-exact predictions."]
+        return lines
+    lines += ["",
+              "Per-link predicted collective time per banked rung "
+              "(replica_groups-exact pricing; exposed = not hidden "
+              "behind compute in an async start/done window — the "
+              "overlap headroom):", "",
+              "| key | ici ms | dcn ms | exposed ms | exposed dcn "
+              "ms |", "|---|---|---|---|---|"]
+    for key, rec in recs:
+        c = rec["comms_ms"]
+        lines.append(
+            f"| {key} | {c.get('ici_ms', '-')} "
+            f"| {c.get('dcn_ms', '-')} | {c.get('exposed_ms', '-')} "
+            f"| {c.get('exposed_dcn_ms', '-')} |")
+    top = []
+    for key, rec in recs:
+        for row in rec["collectives"]:
+            if row.get("exposed_ms", 0) > 0:
+                top.append((key, row))
+    top.sort(key=lambda kr: -kr[1]["exposed_ms"])
+    if top:
+        lines += ["", "Top exposed collectives (the overlap PR's "
+                      "targets, worst first):", "",
+                  "| key | collective | opcode | component | link | "
+                  "group | bytes | predicted ms | exposed ms |",
+                  "|---|---|---|---|---|---|---|---|---|"]
+        for key, row in top[:8]:
+            lines.append(
+                f"| {key} | {row.get('name', '-')} "
+                f"| {row.get('opcode', '-')} "
+                f"| {row.get('component', '-')} "
+                f"| {row.get('link', '-')} "
+                f"| {row.get('num_groups', '-')}x"
+                f"{row.get('group_size', '-')} "
+                f"| {row.get('bytes', '-')} "
+                f"| {row.get('predicted_ms', '-')} "
+                f"| {row.get('exposed_ms', '-')} |")
+    return lines
+
+
 def render_report(logdir: str, attribution: Optional[str] = None,
                   max_events: int = 100,
                   artifacts_dir: Optional[str] = None) -> str:
@@ -943,6 +1012,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.extend(_attribution_section(logdir, attribution))
     lines.append("")
     lines.extend(_predicted_section(artifacts_dir))
+    lines.append("")
+    lines.extend(_comms_section(artifacts_dir))
     lines.append("")
     lines.extend(_serving_section(artifacts_dir))
     lines.append("")
