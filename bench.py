@@ -937,6 +937,16 @@ def run(args, diag: dict) -> None:
             # the replica_groups-exact pricing, so a hardware round
             # banks the link-level prediction next to the measurement
             diag["predicted_comms_ms"] = pred.get("comms_ms")
+            # the memory plan (ISSUE 20): liveness-predicted peak HBM
+            # + headroom against the chip's capacity, next to the
+            # measurement the same way — a hardware round's
+            # memory_stats() peak calibrates this model
+            hbm = pred.get("hbm") or {}
+            cap = hbm.get("capacity") or {}
+            diag["predicted_peak_hbm_bytes"] = \
+                hbm.get("peak_hbm_bytes")
+            diag["predicted_hbm_headroom_bytes"] = \
+                cap.get("headroom_bytes")
             diag["predicted_target"] = pred["target"]
         except Exception as e:  # noqa: BLE001 — prediction is advisory
             print(f"bench: step-time prediction unavailable: {e}",

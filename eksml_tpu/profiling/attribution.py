@@ -201,14 +201,17 @@ _CALLS_SINGLE_RE = re.compile(
     r"\b(?:calls|to_apply|body|condition)=%?([\w.\-]+)")
 _CALLS_LIST_RE = re.compile(r"\bbranch_computations=\{([^}]*)\}")
 _OPERAND_RE = re.compile(r"%([\w.\-]+)")
+_ROOT_RE = re.compile(r"\s*ROOT\b")
 
 
 class Instr:
     __slots__ = ("name", "opcode", "op_name", "calls", "operands",
-                 "cost", "flops", "bytes", "groups")
+                 "cost", "flops", "bytes", "groups", "out_bytes",
+                 "param_number", "is_root")
 
     def __init__(self, name, opcode, op_name, calls, operands, cost,
-                 flops, nbytes, groups=None):
+                 flops, nbytes, groups=None, out_bytes=0.0,
+                 param_number=None, is_root=False):
         self.name = name
         self.opcode = opcode
         self.op_name = op_name          # metadata path ("" if absent)
@@ -218,6 +221,13 @@ class Instr:
         self.flops = flops
         self.bytes = nbytes
         self.groups = groups            # exact replica_groups (or None)
+        self.out_bytes = out_bytes      # OUTPUT shape bytes only — the
+        #                                 buffer this op defines (the
+        #                                 liveness unit in memory.py);
+        #                                 `bytes` above sums every shape
+        #                                 on the line (cost proxy)
+        self.param_number = param_number  # parameter(N) index or None
+        self.is_root = is_root          # computation ROOT marker
 
 
 def _shape_elems_bytes(tokens: List[Tuple[str, str]]) -> int:
@@ -315,8 +325,19 @@ def parse_hlo(text: str) -> Tuple[Dict[str, List[Instr]], Optional[str]]:
             cost = nbytes + flops / FLOPS_PER_BYTE
         groups = (parse_collective_groups(line)
                   if opcode in _COLLECTIVE_OPS else None)
+        # output-only bytes (group 2 is the result shape, possibly a
+        # tuple) — the buffer footprint memory.py tracks; distinct from
+        # `nbytes`, which also sums operand shapes on the line
+        out_bytes = float(_shape_elems_bytes(_SHAPE_RE.findall(_shape)))
+        param_number = None
+        if opcode == "parameter":
+            pm = re.match(r"\s*(\d+)", line[m.end():])
+            if pm:
+                param_number = int(pm.group(1))
+        is_root = bool(_ROOT_RE.match(line))
         cur.append(Instr(name, opcode, op_name, calls, operands, cost,
-                         flops, nbytes, groups))
+                         flops, nbytes, groups, out_bytes, param_number,
+                         is_root))
     return comps, entry
 
 

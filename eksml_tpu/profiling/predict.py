@@ -43,6 +43,7 @@ import logging
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
+from eksml_tpu.profiling import memory
 from eksml_tpu.profiling.attribution import (HloAttribution,
                                              is_collective_opcode)
 
@@ -64,18 +65,21 @@ CHIP_SPECS: Dict[str, Dict[str, Any]] = {
         "hbm_bytes_per_sec": 819e9,
         "ici_bytes_per_sec": 200e9,   # 1600 Gbps aggregate
         "dcn_bytes_per_sec": 25e9,
+        "hbm_bytes": 16e9,            # 16 GB per chip (capacity gate)
     },
     "v4": {
         "peak_flops": {"bfloat16": 275e12, "float32": 137.5e12},
         "hbm_bytes_per_sec": 1228e9,
         "ici_bytes_per_sec": 300e9,   # 2400 Gbps
         "dcn_bytes_per_sec": 25e9,
+        "hbm_bytes": 32e9,
     },
     "v6e": {
         "peak_flops": {"bfloat16": 918e12, "float32": 459e12},
         "hbm_bytes_per_sec": 1640e9,
         "ici_bytes_per_sec": 448e9,   # 3584 Gbps
         "dcn_bytes_per_sec": 25e9,
+        "hbm_bytes": 32e9,
     },
 }
 
@@ -299,7 +303,8 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
                      precision: str = "bfloat16",
                      comm_sizes: Optional[Dict[str, int]] = None,
                      slice_devices: Optional[int] = None,
-                     exchange: str = "flat"
+                     exchange: str = "flat",
+                     input_groups: Optional[List] = None
                      ) -> Dict[str, Any]:
     """Compiled-HLO text → predicted step time for ``target``.
 
@@ -333,7 +338,16 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
     the rest is exposed on the critical path; a sync collective (no
     start/done — every CPU lowering) is fully exposed.  The
     ``exposed_dcn_ms`` figure is the hermetic before/after metric for
-    a future DCN-overlap optimization."""
+    a future DCN-overlap optimization.
+
+    The prediction also carries the HBM observatory (``hbm`` section):
+    liveness-based peak bytes over the same parsed module, the live
+    set at the peak attributed per component, and capacity headroom
+    against the chip spec's ``hbm_bytes`` — see
+    ``eksml_tpu/profiling/memory.py``.  ``input_groups`` (optional
+    ``[(label, leaf_count), ...]`` in entry-signature order, from
+    ``lower_*_step`` meta) splits parameter buffers into
+    params/optimizer/batch for that attribution."""
     spec = chip_spec(target)
     peak = float(spec["peak_flops"].get(precision)
                  or spec["peak_flops"]["bfloat16"])
@@ -468,6 +482,19 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
     for comp, t in comp_sec.items():
         sections_ms[section_of(comp)] += t * 1e3
     total_ms = sum(comp_sec.values()) * 1e3
+
+    # ---- HBM observatory: liveness peak over the same parsed module --
+    hbm_rec = memory.analyze_memory(hlo_text, attr=attr,
+                                    input_groups=input_groups)
+    capacity = float(spec["hbm_bytes"])
+    peak_bytes = hbm_rec.get("peak_hbm_bytes", 0)
+    hbm_rec["capacity"] = {
+        "hbm_bytes": int(capacity),
+        "headroom_bytes": int(capacity - peak_bytes),
+        "utilization_pct": round(100.0 * peak_bytes / capacity, 2),
+        "fits": bool(peak_bytes <= capacity),
+    }
+
     return {
         "target": target,
         "precision": precision,
@@ -480,6 +507,7 @@ def predict_from_hlo(hlo_text: str, target: str = DEFAULT_TARGET,
         "collectives": ledger,
         "totals": {k: round(v, 1) for k, v in totals.items()},
         "comm_sizes": dict(comm_sizes),
+        "hbm": hbm_rec,
     }
 
 
@@ -488,7 +516,9 @@ def predict_for_compiled(hlo_text: str,
                          mesh_shape: Optional[Dict[str, int]] = None,
                          precision: str = "bfloat16",
                          num_slices: int = 1,
-                         exchange: str = "flat") -> Dict[str, Any]:
+                         exchange: str = "flat",
+                         input_groups: Optional[List] = None
+                         ) -> Dict[str, Any]:
     """ONE pricing entry point for an already-compiled program: derive
     the target from the device kind, the collective participant counts
     from the mesh, and the per-slice device count from ``num_slices``
@@ -509,7 +539,8 @@ def predict_for_compiled(hlo_text: str,
     return predict_from_hlo(
         hlo_text, target=target, precision=precision,
         comm_sizes=comm_sizes_for_mesh(mesh_shape),
-        slice_devices=slice_devices, exchange=exchange)
+        slice_devices=slice_devices, exchange=exchange,
+        input_groups=input_groups)
 
 
 # ---- AOT lowering of the real train step (CPU, no hardware) ---------
@@ -636,6 +667,16 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
         opt_sh if plan is not None else None)
     hlo = step.lower(params, opt_state, batch, rng).compile().as_text()
 
+    # entry-signature parameter grouping for the HBM observatory:
+    # (params, opt_state, batch, rng) flatten in argument order, one
+    # HLO entry parameter per leaf — leaf COUNTS are sharding-proof
+    # where leaf bytes would not be (memory.analyze_memory)
+    input_groups = [
+        ["params", len(jax.tree.leaves(params))],
+        ["optimizer", len(jax.tree.leaves(opt_state))],
+        ["batch", len(jax.tree.leaves(batch)) + 1],  # + the rng key
+    ]
+
     meta = {
         "strategy": strategy,
         "batch_size": batch_size,
@@ -650,6 +691,7 @@ def lower_train_step(cfg, batch_size: int, image_size=None,
         "slice_devices": (max(1, n_mesh // ns)
                           if plan is not None else 1),
         "exchange": (exchange if ns > 1 else "flat"),
+        "input_groups": input_groups,
     }
     return hlo, meta
 
@@ -701,6 +743,10 @@ def lower_predict_step(cfg, batch_size: int,
         # single-device inference program: no collectives to price
         "comm_sizes": {},
         "mesh_shape": {},
+        "input_groups": [
+            ["params", len(jax.tree.leaves(params))],
+            ["batch", 2],      # images + true-hw
+        ],
     }
     return hlo, meta
 

@@ -1100,6 +1100,11 @@ class Trainer:
                                                steps=step)
                     if self.writer:
                         self.writer.write_scalars(step, metrics)
+                    # live HBM gauges + the one-time predicted-vs-
+                    # measured peak line (best-effort: CPU backends
+                    # report no memory_stats and this is a silent
+                    # no-op — test-pinned)
+                    self._publish_hbm()
                     log.info("step %d/%d loss=%.4f (%.1f img/s)", step,
                              total_steps, metrics["total_loss"],
                              metrics["images_per_sec"])
@@ -1287,17 +1292,22 @@ class Trainer:
                     precision=str(self.cfg.TRAIN.PRECISION),
                     num_slices=int(self.cfg.TPU.NUM_SLICES))
                 predict_mod.publish_predicted_gauge(pred)
+                # stash the hbm section for the predicted-vs-measured
+                # peak line at the first log step (_publish_hbm)
+                self._predicted_hbm = pred.get("hbm")
                 s = pred["sections_ms"]
                 c = pred.get("comms_ms") or {}
+                h = self._predicted_hbm or {}
                 log.info(
                     "predicted step time (%s roofline): %.2f ms "
                     "(fwd %.2f / bwd %.2f / comms %.2f / "
                     "optimizer %.2f; comms ici %.2f / dcn %.2f / "
-                    "exposed %.2f)",
+                    "exposed %.2f; peak HBM %.1f MB)",
                     pred["target"], pred["predicted_step_time_ms"],
                     s["fwd"], s["bwd"], s["comms"], s["optimizer"],
                     c.get("ici_ms", 0.0), c.get("dcn_ms", 0.0),
-                    c.get("exposed_ms", 0.0))
+                    c.get("exposed_ms", 0.0),
+                    h.get("peak_hbm_bytes", 0) / 1e6)
             except Exception:  # noqa: BLE001 — observability only
                 # the AOT compile is already paid: keep dispatching
                 # it even when the pricing half fell over
@@ -1310,6 +1320,35 @@ class Trainer:
             return jit_step(s, b)  # another bucket: jit as before
 
         return dispatch
+
+    def _publish_hbm(self) -> None:
+        """Publish ``eksml_train_hbm_bytes_in_use`` /
+        ``eksml_train_hbm_peak_bytes`` from the first local device's
+        ``memory_stats()`` at log steps, and — once, when a roofline
+        prediction exists — log predicted-vs-measured peak so
+        calibration evidence for the memory model banks itself on the
+        next hardware round.  Best-effort throughout: backends
+        without the stats (CPU returns None) are a silent no-op."""
+        from eksml_tpu.profiling import memory as memory_mod
+
+        try:
+            device = jax.local_devices()[0]
+        except Exception:  # noqa: BLE001 — observability only
+            return
+        stats = memory_mod.publish_hbm_gauges(device)
+        if stats is None:
+            return
+        predicted = getattr(self, "_predicted_hbm", None) or {}
+        measured_peak = stats.get("peak_bytes")
+        if (measured_peak and predicted.get("peak_hbm_bytes")
+                and not getattr(self, "_hbm_peak_logged", False)):
+            self._hbm_peak_logged = True
+            pp = predicted["peak_hbm_bytes"]
+            log.info(
+                "hbm peak: predicted %.1f MB vs measured %.1f MB "
+                "(x%.2f) — memory-model calibration point",
+                pp / 1e6, measured_peak / 1e6,
+                measured_peak / max(pp, 1))
 
     def _start_capture(self, req: Dict, step: int) -> Dict:
         """Begin a bounded profiler capture: ``jax.profiler`` trace

@@ -1,0 +1,486 @@
+"""The HBM observatory (ISSUE 20).
+
+Three layers, cheapest first:
+- liveness math on hand-rolled scheduled HLO: define-at-producer /
+  free-after-last-use, the donation credit from the
+  ``input_output_alias`` header, fusion-body transients spiking at the
+  call site, and aliasing opcodes (tuple/gte/``*-done``) pinning their
+  underlying buffers instead of allocating;
+- the verdicts: per-component live-at-peak attribution (params /
+  optimizer / batch via ``input_groups``, collectives as
+  comms-staging), the capacity-gate FAIL naming the offender's top
+  live-at-peak components, the peak-regression FAIL naming the
+  component that grew, and the replicated-vs-2d strict peak ordering
+  (both directions);
+- the surfaced views: the committed bank's ``hbm`` sections, the
+  run_report "Memory" table with its pointer degradation, the chip
+  spec capacity field, and the live ``memory_stats()`` gauges with
+  their silent CPU no-op.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eksml_tpu.profiling import memory as M
+from eksml_tpu.profiling import predict as P
+
+F32 = 4  # bytes per f32 element
+
+
+# ---- liveness math on hand-rolled HLO --------------------------------
+
+
+LINEAR_HLO = """
+HloModule linear, is_scheduled=true
+
+ENTRY %main (a: f32[256], b: f32[256]) -> f32[256] {
+  %a = f32[256]{0} parameter(0)
+  %b = f32[256]{0} parameter(1)
+  %t1 = f32[256]{0} add(%a, %b)
+  %t2 = f32[256]{0} multiply(%t1, %a)
+  ROOT %t3 = f32[256]{0} add(%t2, %b)
+}
+"""
+
+
+def test_last_use_free_bounds_the_peak():
+    rec = M.analyze_memory(LINEAR_HLO)
+    # params a+b live throughout (2048); t1 frees after t2 consumes
+    # it, so t3's spike is a+b+t2+t3 = 4096 — NOT the 5120 a
+    # never-free model would report
+    assert rec["peak_hbm_bytes"] == 4 * 256 * F32
+    assert rec["parameter_bytes"] == 2 * 256 * F32
+    assert rec["donated_bytes"] == 0
+    # the timeline records t1's release: the post-peak sample dips
+    live = [pt["live_bytes"] for pt in rec["timeline"]]
+    assert max(live) == rec["peak_hbm_bytes"]
+    assert rec["n_instructions"] == 5
+
+
+DONATED_HLO = """
+HloModule donated, is_scheduled=true, input_output_alias={ {}: (0, {}, may-alias) }, entry_computation_layout={(f32[1024]{0})->f32[1024]{0}}
+
+ENTRY %main (a: f32[1024]) -> f32[1024] {
+  %a = f32[1024]{0} parameter(0)
+  ROOT %out = f32[1024]{0} negate(%a)
+}
+"""
+
+
+def test_donation_credits_the_aliased_output():
+    rec = M.analyze_memory(DONATED_HLO)
+    # the root reuses the donated argument's buffer in place: peak is
+    # ONE copy of the 4096-byte array, and the credit is reported
+    assert rec["peak_hbm_bytes"] == 1024 * F32
+    assert rec["donated_bytes"] == 1024 * F32
+    # strip the header → no credit, two live copies at the root
+    undonated = DONATED_HLO.replace(
+        ", input_output_alias={ {}: (0, {}, may-alias) }", "")
+    rec2 = M.analyze_memory(undonated)
+    assert rec2["peak_hbm_bytes"] == 2 * 1024 * F32
+    assert rec2["donated_bytes"] == 0
+
+
+def test_parse_input_output_alias_forms():
+    assert M.parse_input_output_alias(DONATED_HLO) == {(): 0}
+    hdr = ("HloModule m, input_output_alias={ {0}: (1, {}, "
+           "may-alias), {1}: (3, {}, must-alias) }\n")
+    assert M.parse_input_output_alias(hdr) == {(0,): 1, (1,): 3}
+    assert M.parse_input_output_alias("HloModule m\n") == {}
+
+
+FUSION_HLO = """
+HloModule fused, is_scheduled=true
+
+%fused_body (p0: f32[16]) -> f32[16] {
+  %p0 = f32[16]{0} parameter(0)
+  %big = f32[1024]{0} broadcast(%p0)
+  %small = f32[16]{0} slice(%big)
+  ROOT %fout = f32[16]{0} add(%small, %p0)
+}
+
+ENTRY %main (a: f32[16], b: f32[16]) -> f32[16] {
+  %a = f32[16]{0} parameter(0)
+  %b = f32[16]{0} parameter(1)
+  %t1 = f32[16]{0} add(%a, %b), metadata={op_name="jit(f)/backbone/add"}
+  %t2 = f32[16]{0} fusion(%t1), kind=kLoop, calls=%fused_body
+  ROOT %t3 = f32[16]{0} multiply(%t2, %t1)
+}
+"""
+
+
+def test_fusion_transient_spikes_at_the_call_site():
+    rec = M.analyze_memory(FUSION_HLO)
+    # callee transient: %big (4096) + %small (64) live together
+    # before %big frees — params and the callee root are excluded
+    # (caller-priced).  At the call: a+b+t1 (192) + t2's own output
+    # (64) + transient (4160)
+    assert rec["peak_hbm_bytes"] == 192 + 64 + 4096 + 64
+    assert rec["peak_instruction"] == "t2"
+    assert rec["peak_opcode"] == "fusion"
+    # the transient is attributed to the fusion's component
+    assert rec["live_at_peak_by_component"]["backbone"] >= 4160
+
+
+ALIAS_HLO = """
+HloModule aliasing, is_scheduled=true
+
+ENTRY %main (a: f32[64], b: f32[64]) -> f32[64] {
+  %a = f32[64]{0} parameter(0)
+  %b = f32[64]{0} parameter(1)
+  %t = (f32[64]{0}, f32[64]{0}) tuple(%a, %b)
+  %g = f32[64]{0} get-tuple-element(%t), index=0
+  ROOT %r = f32[64]{0} add(%g, %b)
+}
+"""
+
+
+def test_tuple_and_gte_define_no_storage():
+    rec = M.analyze_memory(ALIAS_HLO)
+    # tuple/gte are views: peak is params + the root's output only
+    assert rec["peak_hbm_bytes"] == 3 * 64 * F32
+    under = M._underlying_map(
+        __import__("eksml_tpu.profiling.attribution",
+                   fromlist=["parse_hlo"]).parse_hlo(ALIAS_HLO)[0]
+        ["main"])
+    assert set(under["t"]) == {"a", "b"}
+    assert under["g"] == ("a", "b")
+
+
+GROUPED_HLO = """
+HloModule grouped, is_scheduled=true
+
+ENTRY %main (p0: f32[64], p1: f32[64], p2: f32[64], p3: f32[8]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %p1 = f32[64]{0} parameter(1)
+  %p2 = f32[64]{0} parameter(2)
+  %p3 = f32[8]{0} parameter(3)
+  %t = f32[64]{0} add(%p0, %p1), metadata={op_name="jit(train)/backbone/add"}
+  %ar = f32[64]{0} all-reduce-start(%t), replica_groups={}
+  %ad = f32[64]{0} all-reduce-done(%ar)
+  ROOT %r = f32[64]{0} multiply(%ad, %p2), metadata={op_name="jit(train)/backbone/mul"}
+}
+"""
+
+
+def test_peak_attribution_splits_params_and_comms_staging():
+    rec = M.analyze_memory(
+        GROUPED_HLO,
+        input_groups=[("params", 2), ("optimizer", 1), ("batch", 1)])
+    comps = rec["live_at_peak_by_component"]
+    # peak at the all-reduce-start: every param, t (its operand) and
+    # the staging buffer the start allocates are live together
+    assert comps["params"] == 2 * 64 * F32
+    assert comps["optimizer"] == 64 * F32
+    assert comps["batch"] == 8 * F32
+    assert comps["comms-staging"] == 64 * F32
+    assert comps["backbone"] == 64 * F32        # t
+    assert rec["peak_hbm_bytes"] == sum(comps.values())
+    # without the groups every parameter pools as "inputs"
+    rec2 = M.analyze_memory(GROUPED_HLO)
+    assert rec2["live_at_peak_by_component"]["inputs"] == \
+        (2 * 64 + 64 + 8) * F32
+
+
+def test_top_components_names_the_heavy_hitters():
+    s = M.top_components({"live_at_peak_by_component":
+                          {"backbone": 12_300_000,
+                           "params": 8_100_000,
+                           "roi-bwd": 4_000_000,
+                           "other": 1}})
+    assert s.startswith("backbone 12.3MB, params 8.1MB")
+    assert "other" not in s
+    assert M.top_components({}) == "no attribution"
+
+
+# ---- the hbm section on predictions ----------------------------------
+
+
+def test_predict_from_hlo_carries_capacity_headroom():
+    pred = P.predict_from_hlo(FUSION_HLO, target="v5e")
+    hbm = pred["hbm"]
+    cap = hbm["capacity"]
+    assert hbm["peak_hbm_bytes"] == 4416
+    assert cap["hbm_bytes"] == int(P.chip_spec("v5e")["hbm_bytes"])
+    assert cap["headroom_bytes"] == cap["hbm_bytes"] - 4416
+    assert cap["fits"] is True
+    assert 0 <= cap["utilization_pct"] < 1
+
+
+def test_every_chip_spec_row_carries_hbm_capacity():
+    # the capacity gate's input: re-introduced with a consumer this
+    # time — a spec row without it would silently skip the gate
+    for name, spec in P.CHIP_SPECS.items():
+        assert float(spec["hbm_bytes"]) > 0, name
+
+
+# ---- gate verdicts ---------------------------------------------------
+
+
+def _fake_pred(peak, components, key="128_b1_replicated_bfloat16",
+               fits=True, rung="128_b1", strategy="replicated"):
+    cap = int(P.chip_spec("v5e")["hbm_bytes"])
+    return {
+        "key": key, "rung": rung, "strategy": strategy,
+        "target": "v5e",
+        "predicted_step_time_ms": 5.0,
+        "sections_ms": {"fwd": 5.0, "bwd": 0.0, "comms": 0.0,
+                        "optimizer": 0.0},
+        "components_ms": {"backbone": 5.0},
+        "hbm": {
+            "peak_hbm_bytes": int(peak),
+            "live_at_peak_by_component": dict(components),
+            "capacity": {"hbm_bytes": cap,
+                         "headroom_bytes": int(cap - peak),
+                         "utilization_pct": round(
+                             100.0 * peak / cap, 2),
+                         "fits": bool(fits and peak <= cap)},
+        },
+    }
+
+
+def test_capacity_gate_fails_naming_the_offender(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+
+    over = int(P.chip_spec("v5e")["hbm_bytes"]) + 5_000_000
+    fresh = _fake_pred(over, {"backbone-bwd": over - 10_000_000,
+                              "params": 10_000_000})
+    row = perf_gate.gate_one(fresh, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=True)
+    assert row["gate"] == "FAIL"
+    assert row["hbm"]["fits"] is False
+    assert "exceeds" in row["error"]
+    # the offender's top live-at-peak components are NAMED
+    assert "backbone-bwd" in row["error"]
+    assert "v5e" in row["error"]
+    assert row["hbm"]["error"] == row["error"]
+
+
+def test_peak_regression_fails_naming_the_component(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+
+    base = _fake_pred(100_000_000, {"backbone": 60_000_000,
+                                    "params": 40_000_000})
+    with open(tmp_path / "perf_pred_128_b1_replicated_bfloat16.json",
+              "w") as f:
+        json.dump(base, f)
+    fresh = _fake_pred(150_000_000, {"backbone": 110_000_000,
+                                     "params": 40_000_000})
+    row = perf_gate.gate_one(fresh, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "FAIL"
+    err = row["hbm"]["error"]
+    # the regressing component's live-at-peak BYTES, both sides
+    assert "backbone" in err
+    assert "60000000" in err and "110000000" in err
+    assert row["hbm"]["baseline_peak_hbm_bytes"] == 100_000_000
+    assert row["hbm"]["peak_regress_pct"] == 50.0
+    # time did not regress → the memory message is the row error
+    assert row["error"] == err
+    # within the bound: PASS, with the delta columns still populated
+    ok = _fake_pred(105_000_000, {"backbone": 65_000_000,
+                                  "params": 40_000_000})
+    row = perf_gate.gate_one(ok, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    assert row["gate"] == "PASS"
+    assert row["hbm"]["peak_regress_pct"] == 5.0
+    assert "error" not in row["hbm"]
+
+
+def test_legacy_records_without_hbm_still_gate(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+
+    base = _fake_pred(100, {"backbone": 100})
+    del base["hbm"]
+    with open(tmp_path / "perf_pred_128_b1_replicated_bfloat16.json",
+              "w") as f:
+        json.dump(base, f)
+    fresh = _fake_pred(100, {"backbone": 100})
+    row = perf_gate.gate_one(fresh, str(tmp_path),
+                             max_regress_pct=10.0,
+                             allow_missing_baseline=False)
+    # pre-observatory baseline: time gates, memory columns ride
+    # without a regression verdict
+    assert row["gate"] == "PASS"
+    assert "baseline_peak_hbm_bytes" not in row["hbm"]
+
+
+def test_cross_strategy_rows_pin_both_directions():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+
+    repl = _fake_pred(100_000_000, {"params": 100_000_000})
+    two_d = _fake_pred(60_000_000, {"params": 60_000_000},
+                       key="128_b1_2d_bfloat16", strategy="2d")
+    rows = perf_gate.hbm_cross_rows([repl, two_d])
+    assert len(rows) == 1
+    assert rows[0]["gate"] == "PASS"
+    assert rows[0]["key"] == "128_b1_hbm_cross_strategy"
+    assert rows[0]["peak_ratio_pct"] == 60.0
+    # the failing direction: 2d NOT strictly below replicated
+    two_d["hbm"]["peak_hbm_bytes"] = 100_000_000
+    rows = perf_gate.hbm_cross_rows([repl, two_d])
+    assert rows[0]["gate"] == "FAIL"
+    assert "not strictly below" in rows[0]["error"]
+    # a lone strategy produces no row (nothing to compare)
+    assert perf_gate.hbm_cross_rows([repl]) == []
+
+
+# ---- the committed bank ----------------------------------------------
+
+
+def _banked(key):
+    with open(os.path.join(REPO, "artifacts",
+                           f"perf_pred_{key}.json")) as f:
+        return json.load(f)
+
+
+def test_banked_default_rungs_carry_hbm():
+    keys = ["128_b1_replicated_bfloat16", "128_b1_fsdp_bfloat16",
+            "128_b1_tensor_bfloat16", "128_b1_2d_bfloat16",
+            "256_b1_replicated_bfloat16", "256_b1_2d_bfloat16",
+            "128_b1_s2_2d_bfloat16", "128_b1_s4_2d_bfloat16",
+            "serve_128x128_b1_bfloat16", "serve_128x128_b4_bfloat16"]
+    for key in keys:
+        hbm = _banked(key).get("hbm") or {}
+        assert hbm.get("peak_hbm_bytes", 0) > 0, key
+        assert hbm["capacity"]["fits"] is True, key
+        assert hbm["live_at_peak_by_component"], key
+        assert hbm["timeline"], key
+
+
+def test_banked_2d_peak_strictly_below_replicated():
+    # PR 15's measured 19.2% storage claim as a hermetic invariant:
+    # at the same rung geometry the 2d lowering's predicted peak is
+    # strictly below replicated's (params/opt/grads divide over
+    # fsdp x model; per-device activations match)
+    for rung in ("128_b1", "256_b1"):
+        repl = _banked(f"{rung}_replicated_bfloat16")["hbm"]
+        two_d = _banked(f"{rung}_2d_bfloat16")["hbm"]
+        assert (two_d["peak_hbm_bytes"]
+                < repl["peak_hbm_bytes"]), rung
+        # the split is visible in the attribution: replicated banks
+        # more parameter+optimizer bytes live at peak than 2d
+        r = repl["live_at_peak_by_component"]
+        d = two_d["live_at_peak_by_component"]
+        assert (r.get("params", 0) + r.get("optimizer", 0)
+                > d.get("params", 0) + d.get("optimizer", 0)), rung
+
+
+def test_banked_train_records_split_parameter_groups():
+    comps = _banked("128_b1_replicated_bfloat16")["hbm"][
+        "live_at_peak_by_component"]
+    # input_groups threaded end-to-end: params AND optimizer buffers
+    # are attributed, not pooled as "inputs"
+    assert comps.get("params", 0) > 0
+    assert comps.get("optimizer", 0) > 0
+    assert "inputs" not in comps
+
+
+@pytest.mark.slow
+def test_real_lowering_orders_strategies(fresh_config):
+    # the acceptance drive on a REAL lowering: replicated vs 2d at
+    # the same geometry, strict peak ordering, through the same
+    # cross-gate rows the CLI appends
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+
+    from eksml_tpu.config import SMOKE_OVERRIDES, finalize_configs
+
+    cfg = fresh_config
+    cfg.update_args(SMOKE_OVERRIDES)
+    cfg.TRAIN.BATCH_SIZE_PER_CHIP = 1
+    cfg.TRAIN.PRECISION = "bfloat16"
+    cfg = finalize_configs(is_training=True)
+    recs = []
+    for strategy in ("replicated", "2d"):
+        hlo, meta = P.lower_train_step(cfg, batch_size=1,
+                                       image_size=128,
+                                       strategy=strategy)
+        pred = P.predict_from_hlo(hlo, comm_sizes=meta["comm_sizes"],
+                                  input_groups=meta["input_groups"])
+        pred.update({"rung": "128_b1", "strategy": strategy})
+        recs.append(pred)
+    rows = perf_gate.hbm_cross_rows(recs)
+    assert len(rows) == 1 and rows[0]["gate"] == "PASS", rows
+    assert (recs[1]["hbm"]["peak_hbm_bytes"]
+            < recs[0]["hbm"]["peak_hbm_bytes"])
+
+
+# ---- run_report "Memory" section -------------------------------------
+
+
+def test_memory_section_degrades_to_pointer(tmp_path):
+    from tools import run_report
+
+    text = "\n".join(run_report._memory_section(str(tmp_path)))
+    assert "## Memory" in text
+    assert "perf_gate.py --update-baseline" in text
+    assert str(tmp_path) in text
+
+
+def test_memory_section_renders_committed_bank():
+    from tools import run_report
+
+    artifacts = os.path.join(REPO, "artifacts")
+    text = "\n".join(run_report._memory_section(artifacts))
+    assert "| 128_b1_replicated_bfloat16 |" in text
+    assert "| 128_b1_2d_bfloat16 |" in text
+    # serve rungs are memory statements too (the one-host HBM claim)
+    assert "| serve_128x128_b1_bfloat16 |" in text
+    assert "headroom" in text
+
+
+# ---- live gauges (satellite a) ----------------------------------------
+
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+def test_publish_hbm_gauges_sets_both_gauges():
+    from eksml_tpu import telemetry
+
+    out = M.publish_hbm_gauges(_FakeDevice(
+        {"bytes_in_use": 123_456, "peak_bytes_in_use": 789_012}))
+    assert out == {"bytes_in_use": 123_456, "peak_bytes": 789_012}
+    reg = telemetry.default_registry()
+    assert reg.get(M.HBM_IN_USE_GAUGE).value == 123_456
+    assert reg.get(M.HBM_PEAK_GAUGE).value == 789_012
+
+
+def test_publish_hbm_gauges_silent_noop_when_absent():
+    # the test-pinned contract: None stats (CPU), key-absent stats,
+    # and a raising backend are ALL silent no-ops
+    assert M.publish_hbm_gauges(_FakeDevice(None)) is None
+    assert M.publish_hbm_gauges(_FakeDevice({})) is None
+    assert M.publish_hbm_gauges(
+        _FakeDevice({"largest_free_block": 1})) is None
+    assert M.publish_hbm_gauges(
+        _FakeDevice(NotImplementedError("no stats"))) is None
+
+
+def test_publish_hbm_gauges_noop_on_real_cpu_backend():
+    import jax
+
+    # jax CPU devices report no memory stats — the exact environment
+    # tier-1 runs in must be the silent no-op
+    assert M.publish_hbm_gauges(jax.local_devices()[0]) is None

@@ -108,6 +108,12 @@
 # opcode heuristic on any pricing path), the per-collective ledger +
 # comms_ms rollup, the exposed-time start/done walk, and the
 # run_report Communication section with its pointer degradation.
+# unit-hbm covers the HBM observatory (ISSUE 20): liveness peak math
+# on hand-rolled HLO (donation credit, fusion transients, last-use
+# frees), per-component live-at-peak attribution, the capacity and
+# peak-regression gate verdicts, the replicated-vs-2d strict peak
+# ordering, and the run_report Memory section with its pointer
+# degradation.
 # The subprocess (proc-*) rungs launch real `python -m eksml_tpu.train`
 # (or `-m eksml_tpu.serve`) processes and are marked slow (excluded
 # from tier-1); the unit and data-* rungs run in seconds.  Everything runs under
@@ -139,6 +145,7 @@ RUNGS=(
   "unit-multislice|tests/test_sharding.py tests/test_parallel.py tests/test_perf_gate.py -k 'slice or hierarchical or multislice'"
   "unit-perfgate|tests/test_perf_gate.py"
   "unit-comms|tests/test_comms_observatory.py"
+  "unit-hbm|tests/test_memory_observatory.py"
   "unit-serve|tests/test_serve.py"
   "unit-serve-reload|tests/test_serve_reload.py"
   "unit-autoscale|tests/test_autoscale.py"

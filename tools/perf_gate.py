@@ -202,7 +202,8 @@ def predict_rung(rung: str, strategy: str, precision: str,
     pred = P.predict_from_hlo(hlo, target=target, precision=precision,
                               comm_sizes=meta["comm_sizes"],
                               slice_devices=slice_devices,
-                              exchange=exchange)
+                              exchange=exchange,
+                              input_groups=meta["input_groups"])
     rec = dict(pred)
     rec.update({
         "rung": rung,
@@ -271,7 +272,8 @@ def predict_serve_rung(rung: str, precision: str, target: str,
     hlo, meta = P.lower_predict_step(
         cfg, batch_size=spec["batch_size"], pad_hw=spec["pad_hw"])
     pred = P.predict_from_hlo(hlo, target=target, precision=precision,
-                              comm_sizes=meta["comm_sizes"])
+                              comm_sizes=meta["comm_sizes"],
+                              input_groups=meta["input_groups"])
     rec = dict(pred)
     rec.update({
         "rung": rung,
@@ -291,9 +293,90 @@ def predict_serve_rung(rung: str, precision: str, target: str,
     return rec
 
 
+def hbm_columns(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The memory verdict columns a prediction record contributes to
+    its gate row — None for pre-observatory records (no ``hbm``)."""
+    hbm = rec.get("hbm") or {}
+    if not hbm.get("peak_hbm_bytes"):
+        return None
+    cap = hbm.get("capacity") or {}
+    return {
+        "peak_hbm_bytes": hbm["peak_hbm_bytes"],
+        "headroom_bytes": cap.get("headroom_bytes"),
+        "utilization_pct": cap.get("utilization_pct"),
+        "fits": bool(cap.get("fits", True)),
+    }
+
+
+def hbm_regression_error(fresh: Dict, base: Dict,
+                         max_regress_pct: float
+                         ) -> Optional[str]:
+    """Peak-HBM regression beyond the bound → the FAIL message naming
+    the component whose live-at-peak bytes grew most; None when in
+    bounds or either record predates the observatory."""
+    fh = fresh.get("hbm") or {}
+    bh = base.get("hbm") or {}
+    fp, bp = fh.get("peak_hbm_bytes"), bh.get("peak_hbm_bytes")
+    if not fp or not bp:
+        return None
+    pct = 100.0 * (float(fp) / float(bp) - 1.0)
+    if pct <= max_regress_pct:
+        return None
+    fc = fh.get("live_at_peak_by_component") or {}
+    bc = bh.get("live_at_peak_by_component") or {}
+    worst = max(set(fc) | set(bc) or {"other"},
+                key=lambda k: fc.get(k, 0) - bc.get(k, 0))
+    return (f"predicted peak HBM regressed +{pct:.1f}% "
+            f"({bp} -> {fp} bytes, bound {max_regress_pct}%); worst "
+            f"component {worst}: live-at-peak {bc.get(worst, 0)} -> "
+            f"{fc.get(worst, 0)} bytes")
+
+
+def hbm_cross_rows(fresh_records: List[Dict[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+    """The sharding cross-gate: at the same rung geometry, the 2d
+    lowering's predicted peak HBM must be STRICTLY below replicated's
+    (params+optimizer+grads divide over fsdp x model while per-device
+    activations match — PR 15's measured 19.2% storage claim as a
+    hermetic invariant).  One verdict row per rung where this run
+    lowered both strategies."""
+    by_rung: Dict[str, Dict[str, Dict]] = {}
+    for rec in fresh_records:
+        rung, strat = rec.get("rung"), rec.get("strategy")
+        if rung and strat in ("replicated", "2d"):
+            by_rung.setdefault(rung, {})[strat] = rec
+    rows: List[Dict[str, Any]] = []
+    for rung in sorted(by_rung):
+        pair = by_rung[rung]
+        if "replicated" not in pair or "2d" not in pair:
+            continue
+        rp = ((pair["replicated"].get("hbm") or {})
+              .get("peak_hbm_bytes"))
+        dp = ((pair["2d"].get("hbm") or {}).get("peak_hbm_bytes"))
+        if not rp or not dp:
+            continue
+        row: Dict[str, Any] = {
+            "key": f"{rung}_hbm_cross_strategy",
+            "check": "2d predicted peak strictly below replicated",
+            "replicated_peak_hbm_bytes": rp,
+            "2d_peak_hbm_bytes": dp,
+            "peak_ratio_pct": round(100.0 * dp / rp, 2),
+            "gate": "PASS" if dp < rp else "FAIL",
+        }
+        if row["gate"] == "FAIL":
+            row["error"] = (
+                f"at rung {rung} the 2d lowering's predicted peak HBM "
+                f"({dp} bytes) is not strictly below replicated's "
+                f"({rp} bytes) — sharding stopped paying for its "
+                f"per-device memory plan")
+        rows.append(row)
+    return rows
+
+
 def gate_one(fresh: Dict, bank_dir: str, max_regress_pct: float,
              allow_missing_baseline: bool) -> Dict[str, Any]:
     """Fresh prediction vs its banked baseline → one result row."""
+    from eksml_tpu.profiling.memory import top_components
     from eksml_tpu.profiling.predict import compare_predictions
 
     path = baseline_path(bank_dir, fresh["key"])
@@ -333,6 +416,21 @@ def gate_one(fresh: Dict, bank_dir: str, max_regress_pct: float,
                 f"{fresh.get('num_slices')} — the exchange pricing "
                 f"or the staged collectives regressed")
             return row
+    mem = hbm_columns(fresh)
+    if mem is not None:
+        # the memory verdict columns (ISSUE 20) ride every row; the
+        # capacity half needs no baseline — a rung that does not fit
+        # the chip FAILs naming its top live-at-peak components
+        row["hbm"] = mem
+        if not mem["fits"]:
+            cap = (fresh["hbm"].get("capacity") or {})
+            row["gate"] = "FAIL"
+            row["error"] = row["hbm"]["error"] = (
+                f"predicted peak HBM {mem['peak_hbm_bytes']} bytes "
+                f"exceeds {fresh.get('target', '?')} capacity "
+                f"{cap.get('hbm_bytes')} bytes — top live-at-peak: "
+                f"{top_components(fresh['hbm'])}")
+            return row
     if base is not None:
         base_widths = row_axis_widths(base)
         if (widths is not None and base_widths is not None
@@ -365,6 +463,24 @@ def gate_one(fresh: Dict, bank_dir: str, max_regress_pct: float,
     row["verdict"] = verdict
     if not ok:
         row["error"] = verdict.get("error")
+    if mem is not None and (base.get("hbm") or {}).get(
+            "peak_hbm_bytes"):
+        # the regression half of the memory verdict: baseline peak +
+        # delta always ride the columns; beyond the bound the row
+        # FAILs naming the component whose live-at-peak bytes grew
+        # most (time error — the pinned message — stays primary when
+        # both regress)
+        base_peak = base["hbm"]["peak_hbm_bytes"]
+        row["hbm"]["baseline_peak_hbm_bytes"] = base_peak
+        row["hbm"]["peak_regress_pct"] = round(
+            100.0 * (float(mem["peak_hbm_bytes"]) / float(base_peak)
+                     - 1.0), 2)
+        mem_err = hbm_regression_error(fresh, base, max_regress_pct)
+        if mem_err:
+            row["gate"] = "FAIL"
+            row["hbm"]["error"] = mem_err
+            if not row.get("error"):
+                row["error"] = mem_err
     return row
 
 
@@ -490,6 +606,7 @@ def main(argv=None) -> int:
                     for strategy in strategies
                     if strategy in PRED_RUNGS[rung].get("strategies",
                                                         strategies)]
+        fresh_records: List[Dict[str, Any]] = []
         for rung, strategy in plan:
             print(f"perf_gate: lowering {rung}"
                   + (f" x {strategy}" if strategy else " (serve)")
@@ -509,6 +626,7 @@ def main(argv=None) -> int:
             # record, and writing it under the flag's key would
             # overwrite the wrong baseline file
             key = fresh["key"]
+            fresh_records.append(fresh)
             run_precision = fresh["precision"]
             print(f"perf_gate: {key}: predicted "
                   f"{fresh['predicted_step_time_ms']}ms "
@@ -539,11 +657,21 @@ def main(argv=None) -> int:
                 if "flat_predicted_step_time_ms" in fresh:
                     banked_row["flat_predicted_step_time_ms"] = (
                         fresh["flat_predicted_step_time_ms"])
+                mem = hbm_columns(fresh)
+                if mem is not None:
+                    banked_row["hbm"] = mem
                 verdict["results"].append(banked_row)
             else:
                 row = gate_one(fresh, args.bank_dir,
                                args.max_regress_pct,
                                args.allow_missing_baseline)
+                ok = ok and row["gate"] != "FAIL"
+                verdict["results"].append(row)
+        if not args.serve:
+            # the sharding memory cross-gate (2d strictly below
+            # replicated at the same rung) runs in BOTH modes —
+            # --update-baseline must never bank a violating pair
+            for row in hbm_cross_rows(fresh_records):
                 ok = ok and row["gate"] != "FAIL"
                 verdict["results"].append(row)
 

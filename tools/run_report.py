@@ -978,6 +978,57 @@ def _comms_section(artifacts_dir: Optional[str]) -> List[str]:
     return lines
 
 
+def _memory_section(artifacts_dir: Optional[str]) -> List[str]:
+    """HBM observatory (ISSUE 20): liveness-predicted peak HBM per
+    banked rung with capacity headroom and the top live-at-peak
+    components — degrading to a pointer exactly like the comms table
+    when no banked prediction carries an ``hbm`` section yet.
+    Includes serve rungs: the serving capacity claim is a memory
+    statement too."""
+    lines = ["## Memory (predicted peak HBM, liveness model)"]
+    if artifacts_dir is None:
+        artifacts_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))), "artifacts")
+    preds = sorted(glob.glob(os.path.join(artifacts_dir,
+                                          "perf_pred_*.json")))
+    recs = []
+    for path in preds:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        if (rec.get("hbm") or {}).get("peak_hbm_bytes"):
+            recs.append((rec.get("key", os.path.basename(path)), rec))
+    if not recs:
+        lines += ["", "No banked prediction carries an `hbm` section "
+                      f"in `{artifacts_dir}` — run `python "
+                      "tools/perf_gate.py --update-baseline` to bank "
+                      "liveness-based peak-memory predictions."]
+        return lines
+    lines += ["",
+              "Liveness-predicted peak HBM per banked rung (define at "
+              "producer, free after last use; donation credited; "
+              "upper-ish bound — XLA may rematerialize under "
+              "pressure):", "",
+              "| key | peak MB | capacity MB | headroom MB | util % | "
+              "top live-at-peak |", "|---|---|---|---|---|---|"]
+    for key, rec in recs:
+        h = rec["hbm"]
+        cap = h.get("capacity") or {}
+        comps = h.get("live_at_peak_by_component") or {}
+        top = ", ".join(f"{k} {v / 1e6:.1f}MB"
+                        for k, v in list(comps.items())[:3])
+        lines.append(
+            f"| {key} | {h['peak_hbm_bytes'] / 1e6:.1f} "
+            f"| {cap.get('hbm_bytes', 0) / 1e6:.0f} "
+            f"| {cap.get('headroom_bytes', 0) / 1e6:.1f} "
+            f"| {cap.get('utilization_pct', '-')} "
+            f"| {top or '-'} |")
+    return lines
+
+
 def render_report(logdir: str, attribution: Optional[str] = None,
                   max_events: int = 100,
                   artifacts_dir: Optional[str] = None) -> str:
@@ -1014,6 +1065,8 @@ def render_report(logdir: str, attribution: Optional[str] = None,
     lines.extend(_predicted_section(artifacts_dir))
     lines.append("")
     lines.extend(_comms_section(artifacts_dir))
+    lines.append("")
+    lines.extend(_memory_section(artifacts_dir))
     lines.append("")
     lines.extend(_serving_section(artifacts_dir))
     lines.append("")
